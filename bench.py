@@ -1,0 +1,99 @@
+"""Benchmark: IWAE k=50, 2-stochastic-layer flagship train throughput.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "steps/sec", "vs_baseline": N}``
+
+`value` is the jitted JAX train-step throughput on the available accelerator
+(one TPU chip under the driver). `vs_baseline` is the speedup over a freshly
+measured eager-CPU baseline (the torch oracle backend, standing in for the
+reference's eager TF2-CPU execution — BASELINE.md records no published
+throughput, so the baseline is measured, not assumed; north-star target is
+>=10x).
+
+Set BENCH_SKIP_BASELINE=1 to reuse the last cached baseline measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+BATCH = 100
+K = 50
+WARMUP = 5
+ITERS = 30
+BASELINE_ITERS = 3
+BASELINE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              ".bench_baseline.json")
+
+
+def make_data(n=BATCH):
+    return (np.random.RandomState(0).rand(n, 784) > 0.5).astype(np.float32)
+
+
+def bench_jax() -> float:
+    import jax
+
+    from iwae_replication_project_tpu.models import ModelConfig
+    from iwae_replication_project_tpu.objectives import ObjectiveSpec
+    from iwae_replication_project_tpu.training import create_train_state, make_train_step
+
+    cfg = ModelConfig.two_layer()
+    spec = ObjectiveSpec("IWAE", k=K)
+    state = create_train_state(jax.random.PRNGKey(0), cfg)
+    step = make_train_step(spec, cfg, donate=False)
+    x = jax.numpy.asarray(make_data())
+
+    for _ in range(WARMUP):
+        state, m = step(state, x)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, m = step(state, x)
+    jax.block_until_ready(m["loss"])
+    return ITERS / (time.perf_counter() - t0)
+
+
+def bench_baseline() -> float:
+    """Eager-CPU steps/sec (torch oracle), cached across runs."""
+    if os.environ.get("BENCH_SKIP_BASELINE") and os.path.exists(BASELINE_CACHE):
+        with open(BASELINE_CACHE) as f:
+            return json.load(f)["steps_per_sec"]
+    import torch
+
+    torch.set_num_threads(max(1, os.cpu_count() or 1))
+    from iwae_replication_project_tpu.api import FlexibleModel
+
+    mdl = FlexibleModel([200, 100], [100, 200], [100, 50], [100, 784],
+                        dataset_bias=None, loss_function="IWAE", k=K,
+                        backend="torch").compile()
+    x = torch.from_numpy(make_data())
+    mdl.train_step(x)  # warmup
+    t0 = time.perf_counter()
+    for _ in range(BASELINE_ITERS):
+        mdl.train_step(x)
+    sps = BASELINE_ITERS / (time.perf_counter() - t0)
+    try:
+        with open(BASELINE_CACHE, "w") as f:
+            json.dump({"steps_per_sec": sps, "time": time.time()}, f)
+    except OSError:
+        pass
+    return sps
+
+
+def main():
+    jax_sps = bench_jax()
+    base_sps = bench_baseline()
+    print(json.dumps({
+        "metric": "IWAE-k50-2L train throughput (batch 100)",
+        "value": round(jax_sps, 2),
+        "unit": "steps/sec",
+        "vs_baseline": round(jax_sps / base_sps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""TPU-native importance-weighted autoencoder framework.
+
+A brand-new JAX/XLA/pjit framework with the capabilities of the reference
+``CharlesArnal/IWAE_replication_project`` (see /root/repo/SURVEY.md): training and
+evaluating VAEs/IWAEs and their variants on (binarized) MNIST, Fashion-MNIST and
+Omniglot, with TPU-first execution — ``jit`` + batched-k compute on the MXU,
+data-parallel and sample-parallel sharding over a `jax.sharding.Mesh`, and
+streaming large-k evaluation.
+
+The design spine (reference: flexible_IWAE.py:327-430): every objective is a
+reduction of a ``[k, batch]`` log-importance-weight tensor. Here that tensor is
+produced by one pure function, :func:`models.log_weights`, and every estimator in
+:mod:`objectives` is a pure reduction of it.
+"""
+
+__version__ = "0.1.0"
+
+from iwae_replication_project_tpu.models import iwae as models  # noqa: F401
+from iwae_replication_project_tpu import objectives  # noqa: F401
+
+__all__ = ["models", "objectives", "__version__"]

@@ -1,0 +1,4 @@
+from iwae_replication_project_tpu.experiment import main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""User-facing API: a ``FlexibleModel`` class mirroring the reference's surface.
+
+The reference exposes everything through one Keras subclass
+(``Flexible_Model``, flexible_IWAE.py:177-545). This facade keeps that
+method-for-method surface — ``fit``/``train_step``/``get_L*``/``get_NLL``/
+``get_training_statistics``/``tensorboard_log``/``save_weights`` — while the
+implementation underneath is the functional TPU-native core, selected by a
+``backend=`` switch (the BASELINE.json north-star requirement):
+
+* ``backend="jax"``  — jit/SPMD execution (default). Accepts an optional
+  device mesh for data/sample parallelism.
+* ``backend="torch"``— eager CPU oracle with the same semantics, standing in
+  for the reference's eager-TF2 path (TF is not in this environment); used for
+  cross-backend parity tests and as the CPU-eager baseline in bench.py.
+* ``backend="tf2"``  — gated: constructing it raises with guidance unless
+  TensorFlow is importable (it is not baked into this image).
+
+Ctor signature order follows the reference (flexible_IWAE.py:178-180):
+``(..., dataset_bias, loss_function, k, p, alpha, beta)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from iwae_replication_project_tpu.objectives.estimators import ObjectiveSpec
+
+
+class FlexibleModel:
+    def __new__(cls, *args, backend: str = "jax", **kwargs):
+        if cls is not FlexibleModel:
+            return super().__new__(cls)
+        if backend == "jax":
+            from iwae_replication_project_tpu.backends.jax_backend import JaxFlexibleModel
+            return super().__new__(JaxFlexibleModel)
+        if backend == "torch":
+            from iwae_replication_project_tpu.backends.torch_ref import TorchFlexibleModel
+            return super().__new__(TorchFlexibleModel)
+        if backend == "tf2":
+            try:
+                import tensorflow  # noqa: F401
+            except ImportError as e:
+                raise ImportError(
+                    "backend='tf2' requires TensorFlow, which is not installed "
+                    "in this environment. Use backend='jax' (TPU) or "
+                    "backend='torch' (eager CPU oracle).") from e
+            raise NotImplementedError(
+                "backend='tf2' is a compatibility shim pending a TF install; "
+                "use backend='jax' or backend='torch'.")
+        raise ValueError(f"unknown backend {backend!r}; choose jax|torch|tf2")
+
+    def __init__(self, n_hidden_encoder: Sequence[int],
+                 n_hidden_decoder: Sequence[int],
+                 n_latent_encoder: Sequence[int],
+                 n_latent_decoder: Sequence[int],
+                 dataset_bias="binarized_mnist",
+                 loss_function: str = "VAE", k: int = 50, p: float = 1,
+                 alpha: float = 1, beta: float = 0.5, *,
+                 backend: str = "jax", k2: int = 1, seed: int = 0,
+                 data_dir: str = "data"):
+        """`dataset_bias` is either a dataset name (bias means resolved via the
+        data layer, like flexible_IWAE.py:147-175 but without ctor-time network
+        I/O — local files or synthetic fallback) or a ``[784]`` array of pixel
+        means / a precomputed bias vector passed directly."""
+        self.n_hidden_encoder = tuple(n_hidden_encoder)
+        self.n_hidden_decoder = tuple(n_hidden_decoder)
+        self.n_latent_encoder = tuple(n_latent_encoder)
+        self.n_latent_decoder = tuple(n_latent_decoder)
+        self.loss_function = loss_function
+        self.k = k
+        self.p = p
+        self.alpha = alpha
+        self.beta = beta
+        self.k2 = k2
+        self.seed = seed
+        self.epoch = 0  # per-batch counter, reference-compatible name (flexible_IWAE.py:245)
+        self.dataset_bias = dataset_bias
+        self._output_bias = self._resolve_bias(dataset_bias, data_dir)
+
+    # -- shared helpers ----------------------------------------------------
+
+    @staticmethod
+    def _resolve_bias(dataset_bias, data_dir: str) -> Optional[np.ndarray]:
+        from iwae_replication_project_tpu.data import (
+            load_dataset, output_bias_from_pixel_means)
+        if dataset_bias is None:
+            return None
+        if isinstance(dataset_bias, str):
+            ds = load_dataset(dataset_bias, data_dir=data_dir, allow_synthetic=True)
+            return ds.output_bias
+        arr = np.asarray(dataset_bias, np.float32)
+        if arr.ndim != 1:
+            raise ValueError("dataset_bias array must be 1-D (pixel means or bias)")
+        # heuristic: values in [0,1] are pixel means; otherwise already a bias
+        if arr.min() >= 0.0 and arr.max() <= 1.0:
+            return output_bias_from_pixel_means(arr)
+        return arr
+
+    def objective_spec(self, name: Optional[str] = None, k: Optional[int] = None,
+                       **over) -> ObjectiveSpec:
+        return ObjectiveSpec(
+            name=name or self.loss_function, k=k if k is not None else self.k,
+            p=over.get("p", self.p), alpha=over.get("alpha", self.alpha),
+            beta=over.get("beta", self.beta), k2=over.get("k2", self.k2))

@@ -1,0 +1,277 @@
+"""The JAX/TPU implementation behind the FlexibleModel facade.
+
+Every reference method (flexible_IWAE.py:221-545) maps onto the functional
+core: the class only holds state (params/opt/rng) and memoizes jitted
+callables; all math lives in models/, objectives/, evaluation/.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from iwae_replication_project_tpu.api import FlexibleModel
+import iwae_replication_project_tpu.evaluation.activity as au
+import iwae_replication_project_tpu.evaluation.metrics as ev
+from iwae_replication_project_tpu.models import iwae as model
+from iwae_replication_project_tpu.objectives import (
+    ObjectiveSpec,
+    bound_from_log_weights,
+)
+from iwae_replication_project_tpu.training import train_step as ts
+from iwae_replication_project_tpu.utils.logging import MetricsLogger
+
+
+class JaxFlexibleModel(FlexibleModel):
+    def __init__(self, *args, mesh=None, mesh_sp: int = 1,
+                 compute_dtype: Optional[str] = None, likelihood: str = "clamp",
+                 **kwargs):
+        # backend-specific kwargs are consumed above; everything else must be a
+        # known base-ctor parameter (typos raise instead of silently training
+        # with defaults)
+        super().__init__(*args, **kwargs)
+        self.cfg = model.ModelConfig(
+            n_hidden_enc=self.n_hidden_encoder,
+            n_latent_enc=self.n_latent_encoder,
+            n_hidden_dec=self.n_hidden_decoder,
+            n_latent_dec=self.n_latent_decoder,
+            x_dim=self.n_latent_decoder[-1],
+            likelihood=likelihood,
+            compute_dtype=compute_dtype,
+        )
+        self.mesh = mesh
+        self.mesh_sp = mesh_sp
+        self._optimizer = None
+        self.state: Optional[ts.TrainState] = None
+        self._step_fn = None
+        self._eval_key = jax.random.PRNGKey(self.seed + 1)
+        self._logger: Optional[MetricsLogger] = None
+
+    # ------------------------------------------------------------------
+    # training surface (reference: compile/fit/train_step)
+    # ------------------------------------------------------------------
+
+    def compile(self, optimizer=None, learning_rate: float = 1e-3):
+        """Build params + optimizer state (Keras-API parity; reference
+        compiles with Adam eps=1e-4, experiment_example.py:36-40)."""
+        self._optimizer = optimizer or ts.make_adam(learning_rate)
+        self.state = ts.create_train_state(
+            jax.random.PRNGKey(self.seed), self.cfg,
+            output_bias=self._output_bias, optimizer=self._optimizer)
+        spec = self.objective_spec()
+        if self.mesh is not None:
+            from iwae_replication_project_tpu.parallel import (
+                dp as pdp, make_parallel_train_step)
+            self._step_fn = make_parallel_train_step(
+                spec, self.cfg, self.mesh, optimizer=self._optimizer, donate=False)
+            self.state = pdp.replicate(self.mesh, self.state)
+            self._place_batch = lambda b: pdp.shard_batch(self.mesh, b)
+        else:
+            self._step_fn = ts.make_train_step(spec, self.cfg,
+                                               optimizer=self._optimizer, donate=False)
+            self._place_batch = jnp.asarray
+        return self
+
+    def set_learning_rate(self, lr: float):
+        self.state = ts.set_learning_rate(self.state, lr)
+
+    def train_step(self, x) -> Dict[str, float]:
+        """One optimizer step on one batch (parity: flexible_IWAE.py:221-247)."""
+        self._require_compiled()
+        x = self._place_batch(self._flatten(x))
+        self.state, metrics = self._step_fn(self.state, x)
+        self.epoch += 1
+        return {self.loss_function: float(metrics["loss"])}
+
+    def fit(self, x_train, epochs: int = 1, batch_size: int = 100,
+            binarization: str = "none", shuffle: bool = True,
+            verbose: bool = False) -> Dict[str, list]:
+        """Epoch loop over host batches (replaces keras .fit,
+        experiment_example.py:82)."""
+        from iwae_replication_project_tpu.data import epoch_batches
+        self._require_compiled()
+        x_train = self._flatten(np.asarray(x_train))
+        history = {"loss": []}
+        for e in range(epochs):
+            losses = []
+            for batch in epoch_batches(x_train, batch_size, epoch=self.epoch + e,
+                                       seed=self.seed, binarization=binarization,
+                                       shuffle=shuffle):
+                self.state, metrics = self._step_fn(self.state, self._place_batch(batch))
+                self.epoch += 1
+                losses.append(float(metrics["loss"]))
+            history["loss"].append(float(np.mean(losses)))
+            if verbose:
+                print(f"epoch {e + 1}/{epochs}: loss={history['loss'][-1]:.4f}")
+        return history
+
+    # ------------------------------------------------------------------
+    # objectives surface (reference get_L_* family)
+    # ------------------------------------------------------------------
+
+    def get_log_weights(self, x, n_samples: int):
+        self._require_compiled()
+        return model.log_weights(self.params, self.cfg, self._next_eval_key(),
+                                 self._flatten(x), n_samples)
+
+    def _bound(self, name: str, x, k: int, **over) -> jnp.ndarray:
+        self._require_compiled()
+        spec = self.objective_spec(name=name, k=k, **over)
+        log_w, aux = model.log_weights_and_aux(
+            self.params, self.cfg, self._next_eval_key(), self._flatten(x), k)
+        return bound_from_log_weights(spec, log_w, aux)
+
+    def get_L(self, x, k: int = 5000):
+        return self._bound("VAE", x, k)
+
+    def get_L_k(self, x, k: int):
+        return self._bound("IWAE", x, k)
+
+    def get_L_V1(self, x, n_samples: int):
+        return self._bound("VAE_V1", x, n_samples)
+
+    def get_L_alpha(self, x, n_samples: int, alpha: float):
+        return self._bound("L_alpha", x, n_samples, alpha=alpha)
+
+    def get_L_power_p(self, x, k: int, p: float):
+        return self._bound("L_power_p", x, k, p=p)
+
+    def get_L_median(self, x, k: int):
+        return self._bound("L_median", x, k)
+
+    def get_L_CIWAE(self, x, n_samples: int, beta: float):
+        return self._bound("CIWAE", x, n_samples, beta=beta)
+
+    def get_L_MIWAE(self, x, k1: int, k2: int):
+        return self._bound("MIWAE", x, k1 * k2, k2=k2)
+
+    # ------------------------------------------------------------------
+    # evaluation surface
+    # ------------------------------------------------------------------
+
+    def get_NLL(self, x, k: int = 5000, chunk: int = 100):
+        self._require_compiled()
+        return ev.streaming_nll(self.params, self.cfg, self._next_eval_key(),
+                                self._flatten(x), k=k, chunk=chunk)
+
+    def reconstructed_x_probs(self, x):
+        self._require_compiled()
+        return model.reconstruct_probs(self.params, self.cfg,
+                                       self._next_eval_key(), self._flatten(x))
+
+    def get_reconstruction_loss(self, x):
+        self._require_compiled()
+        return ev.reconstruction_loss(self.params, self.cfg,
+                                      self._next_eval_key(), self._flatten(x))
+
+    def get_E_qhIx_log_pxIh(self, x, n_samples: int):
+        self._require_compiled()
+        _, aux = model.log_weights_and_aux(self.params, self.cfg,
+                                           self._next_eval_key(),
+                                           self._flatten(x), n_samples)
+        return jnp.mean(aux["log_px_given_h"])
+
+    def get_Dkl_qhIx_ph(self, x, k: int):
+        """E_q[log p(x|h)] - L (flexible_IWAE.py:414-415), single pass."""
+        self._require_compiled()
+        log_w, aux = model.log_weights_and_aux(self.params, self.cfg,
+                                               self._next_eval_key(),
+                                               self._flatten(x), k)
+        return jnp.mean(aux["log_px_given_h"]) - jnp.mean(log_w)
+
+    def get_Dkl_qhIx_phIx(self, x, k: int):
+        """L_5000 - L (flexible_IWAE.py:411-412)."""
+        return -(self.get_L(x, k) + self.get_NLL(x))
+
+    def get_levels_of_units_activity(self, x, n_samples: int):
+        self._require_compiled()
+        return au.posterior_mean_activity(self.params, self.cfg,
+                                          self._next_eval_key(),
+                                          self._flatten(x), n_samples=n_samples)
+
+    def get_eigenvalues_PCA(self, data):
+        return au.pca_eigenvalues(jnp.asarray(data))
+
+    def get_active_units(self, variances, eigen_values, threshold: float = 0.01):
+        return au.active_units(variances, eigen_values, threshold)
+
+    def get_NLL_without_inactive_units(self, x, threshold: float = 0.01,
+                                       n_samples: int = 5000,
+                                       activity_samples: int = 1000):
+        self._require_compiled()
+        x = self._flatten(x)
+        variances, eigvals = self.get_levels_of_units_activity(x, activity_samples)
+        masks, _, _ = au.active_units(variances, eigvals, threshold)
+        return au.nll_without_inactive_units(self.params, self.cfg,
+                                             self._next_eval_key(), x, masks,
+                                             k=n_samples)
+
+    def get_training_statistics(self, x, k: int, batch_size: int = 100, **kw
+                                ) -> Tuple[dict, dict]:
+        self._require_compiled()
+        return ev.training_statistics(self.params, self.cfg,
+                                      self._next_eval_key(), self._flatten(x),
+                                      k, batch_size=batch_size, **kw)
+
+    def generate(self, n: int, key=None):
+        """Ancestral samples from the prior -> pixel probs ``[n, x_dim]``."""
+        self._require_compiled()
+        key = key if key is not None else self._next_eval_key()
+        k1, k2 = jax.random.split(key)
+        h_top = jax.random.normal(k1, (1, n, self.cfg.n_latent_enc[-1]))
+        return model.generate_x(self.params, self.cfg, k2, h_top)[0]
+
+    # ------------------------------------------------------------------
+    # observability / persistence
+    # ------------------------------------------------------------------
+
+    def tensorboard_log(self, res: dict, epoch_n: int = -1,
+                        logdir: str = "runs"):
+        """Write the eval scalars (reference schema, flexible_IWAE.py:529-545)."""
+        if self._logger is None:
+            self._logger = MetricsLogger(logdir, run_name=self._run_name())
+        self._logger.log(res, step=self.epoch if epoch_n == -1 else epoch_n)
+
+    def save_weights(self, path: str):
+        self._require_compiled()
+        flat, treedef = jax.tree.flatten(self.params)
+        with open(path if path.endswith(".pkl") else path + ".pkl", "wb") as f:
+            pickle.dump({"arrays": [np.asarray(a) for a in flat],
+                         "treedef": str(treedef)}, f)
+
+    def load_weights(self, path: str):
+        self._require_compiled()
+        with open(path if path.endswith(".pkl") else path + ".pkl", "rb") as f:
+            payload = pickle.load(f)
+        flat, treedef = jax.tree.flatten(self.params)
+        if len(flat) != len(payload["arrays"]):
+            raise ValueError("checkpoint structure mismatch")
+        self.state = self.state._replace(
+            params=jax.tree.unflatten(jax.tree.structure(self.params),
+                                      [jnp.asarray(a) for a in payload["arrays"]]))
+
+    # ------------------------------------------------------------------
+
+    @property
+    def params(self):
+        return self.state.params
+
+    def _run_name(self) -> str:
+        return f"{self.loss_function}-{len(self.n_hidden_encoder)}L-k_{self.k}"
+
+    def _require_compiled(self):
+        if self.state is None:
+            raise RuntimeError("call .compile() before training/evaluation")
+
+    def _next_eval_key(self):
+        self._eval_key, sub = jax.random.split(self._eval_key)
+        return sub
+
+    @staticmethod
+    def _flatten(x):
+        x = jnp.asarray(x, jnp.float32)
+        return x.reshape(x.shape[0], -1)

@@ -1,0 +1,236 @@
+"""Eager CPU oracle backend (torch) with the same semantics as the JAX path.
+
+Role (TF2 is not installed in this image, so torch stands in for the
+reference's eager-TF2 execution style, cf. flexible_IWAE.py:220's commented-out
+@tf.function):
+
+1. an independent implementation for cross-backend parity tests — same
+   architecture, same clamps (prob clamp 1e-6/1e-7, std floor 1e-6), same
+   Adam(eps=1e-4) — any systematic bug in the JAX path shows up as a
+   divergence here;
+2. the measured CPU-eager baseline for bench.py's ``vs_baseline`` speedup
+   (BASELINE.md: no published throughput; the >=10x target is against a fresh
+   eager-CPU run).
+
+Per-op autograd, dynamic dispatch, no fusion — deliberately the execution
+model the reference used.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+import torch
+
+from iwae_replication_project_tpu.api import FlexibleModel
+
+_PCLAMP_SCALE = 1.0 - 1e-6
+_PCLAMP_SHIFT = 1e-7
+_STD_FLOOR = 1e-6
+
+
+class _StochasticBlock(torch.nn.Module):
+    def __init__(self, in_dim: int, hidden: int, latent: int):
+        super().__init__()
+        self.l1 = torch.nn.Linear(in_dim, hidden)
+        self.l2 = torch.nn.Linear(hidden, hidden)
+        self.mu = torch.nn.Linear(hidden, latent)
+        self.lstd = torch.nn.Linear(hidden, latent)
+
+    def forward(self, x):
+        y = torch.tanh(self.l1(x))
+        y = torch.tanh(self.l2(y))
+        return self.mu(y), torch.exp(self.lstd(y)) + _STD_FLOOR
+
+
+def _normal_log_prob(x, mu, std):
+    z = (x - mu) / std
+    return -0.5 * z * z - torch.log(std) - 0.5 * float(np.log(2 * np.pi))
+
+
+class TorchFlexibleModel(FlexibleModel):
+    def __init__(self, *args, mesh=None, mesh_sp: int = 1, compute_dtype=None,
+                 likelihood: str = "clamp", **kwargs):
+        # accept (and ignore) the jax-backend execution kwargs so callers can
+        # flip backend= without changing anything else; unknown kwargs raise
+        super().__init__(*args, **kwargs)
+        torch.manual_seed(self.seed)
+        L = len(self.n_hidden_encoder)
+        self.L = L
+        enc, in_dim = [], self.n_latent_decoder[-1]
+        for i in range(L):
+            enc.append(_StochasticBlock(in_dim, self.n_hidden_encoder[i],
+                                        self.n_latent_encoder[i]))
+            in_dim = self.n_latent_encoder[i]
+        self.enc = torch.nn.ModuleList(enc)
+        dec, in_dim = [], self.n_latent_encoder[-1]
+        for i in range(L - 1):
+            dec.append(_StochasticBlock(in_dim, self.n_hidden_decoder[i],
+                                        self.n_latent_decoder[i]))
+            in_dim = self.n_latent_decoder[i]
+        self.dec = torch.nn.ModuleList(dec)
+        out_dim = self.n_latent_decoder[-1]
+        self.out = torch.nn.Sequential(
+            torch.nn.Linear(in_dim, self.n_hidden_decoder[-1]), torch.nn.Tanh(),
+            torch.nn.Linear(self.n_hidden_decoder[-1], self.n_hidden_decoder[-1]),
+            torch.nn.Tanh(),
+            torch.nn.Linear(self.n_hidden_decoder[-1], out_dim))
+        if self._output_bias is not None:
+            with torch.no_grad():
+                self.out[-1].bias.copy_(torch.from_numpy(
+                    np.asarray(self._output_bias, np.float32)))
+        self.optimizer: Optional[torch.optim.Optimizer] = None
+
+    # ------------------------------------------------------------------
+
+    def compile(self, optimizer=None, learning_rate: float = 1e-3):
+        params = list(self.enc.parameters()) + list(self.dec.parameters()) \
+            + list(self.out.parameters())
+        self.optimizer = optimizer or torch.optim.Adam(
+            params, lr=learning_rate, betas=(0.9, 0.999), eps=1e-4)
+        return self
+
+    def set_learning_rate(self, lr: float):
+        for g in self.optimizer.param_groups:
+            g["lr"] = lr
+
+    def _encode(self, x, k: int):
+        mu, std = self.enc[0](x)
+        h1 = mu + std * torch.randn((k,) + mu.shape)
+        log_q = _normal_log_prob(h1, mu, std).sum(-1)
+        h = [h1]
+        q_last = (mu, std)
+        for i in range(1, self.L):
+            mu, std = self.enc[i](h[-1])
+            hi = mu + std * torch.randn(mu.shape)
+            log_q = log_q + _normal_log_prob(hi, mu, std).sum(-1)
+            h.append(hi)
+            q_last = (mu, std)
+        return h, log_q, q_last
+
+    def _decode_probs(self, h1):
+        probs = torch.sigmoid(self.out(h1))
+        return probs * _PCLAMP_SCALE + _PCLAMP_SHIFT
+
+    def _log_weights_aux(self, x, k: int):
+        h, log_q, q_last = self._encode(x, k)
+        probs = self._decode_probs(h[0])
+        log_pxIh = (x * torch.log(probs) + (1 - x) * torch.log1p(-probs)).sum(-1)
+        log_ph = (-0.5 * h[-1] ** 2 - 0.5 * float(np.log(2 * np.pi))).sum(-1)
+        for i in range(self.L - 1):
+            mu, std = self.dec[i](h[self.L - 1 - i])
+            log_ph = log_ph + _normal_log_prob(h[self.L - 2 - i], mu, std).sum(-1)
+        return log_ph + log_pxIh - log_q, {"log_px_given_h": log_pxIh,
+                                           "q_last": q_last, "h": h}
+
+    def get_log_weights(self, x, n_samples: int):
+        return self._log_weights_aux(self._flatten(x), n_samples)[0]
+
+    @staticmethod
+    def _iwae(log_w):
+        m = log_w.max(dim=0, keepdim=True).values.detach()
+        return (torch.log(torch.exp(log_w - m).mean(0)) + m[0]).mean()
+
+    def _bound(self, name, x, k, **over):
+        x = self._flatten(x)
+        log_w, aux = self._log_weights_aux(x, k)
+        if name == "VAE":
+            return log_w.mean()
+        if name == "IWAE":
+            return self._iwae(log_w)
+        if name == "L_power_p":
+            p = over.get("p", self.p)
+            return self._iwae(p * log_w) / p
+        if name == "L_median":
+            return log_w.median(dim=0).values.mean()
+        if name == "CIWAE":
+            b = over.get("beta", self.beta)
+            return b * log_w.mean() + (1 - b) * self._iwae(log_w)
+        if name == "L_alpha":
+            a = over.get("alpha", self.alpha)
+            return (1 - a) * aux["log_px_given_h"].mean() + a * log_w.mean()
+        if name == "MIWAE":
+            k2 = over.get("k2", self.k2)
+            g = log_w.reshape(k2, k // k2, *log_w.shape[1:])
+            m = g.max(dim=1, keepdim=True).values.detach()
+            return (torch.log(torch.exp(g - m).mean(1)) + m[:, 0]).mean()
+        if name == "VAE_V1":
+            mu, std = aux["q_last"]
+            kl = (-0.5 * (1 + 2 * torch.log(std) - mu ** 2 - std ** 2)).sum(-1).mean()
+            return aux["log_px_given_h"].mean() - kl
+        raise NotImplementedError(
+            f"objective {name!r} is not implemented in the torch oracle backend")
+
+    def get_L(self, x, k: int = 5000):
+        return self._bound("VAE", x, k)
+
+    def get_L_k(self, x, k: int):
+        return self._bound("IWAE", x, k)
+
+    def get_L_V1(self, x, n_samples: int):
+        return self._bound("VAE_V1", x, n_samples)
+
+    def get_L_alpha(self, x, n_samples: int, alpha: float):
+        return self._bound("L_alpha", x, n_samples, alpha=alpha)
+
+    def get_L_power_p(self, x, k: int, p: float):
+        return self._bound("L_power_p", x, k, p=p)
+
+    def get_L_median(self, x, k: int):
+        return self._bound("L_median", x, k)
+
+    def get_L_CIWAE(self, x, n_samples: int, beta: float):
+        return self._bound("CIWAE", x, n_samples, beta=beta)
+
+    def get_L_MIWAE(self, x, k1: int, k2: int):
+        return self._bound("MIWAE", x, k1 * k2, k2=k2)
+
+    def train_step(self, x) -> Dict[str, float]:
+        if self.optimizer is None:
+            raise RuntimeError("call .compile() first")
+        loss = -self._bound(self.loss_function, x, self.k)
+        self.optimizer.zero_grad()
+        loss.backward()
+        self.optimizer.step()
+        self.epoch += 1
+        return {self.loss_function: float(loss.detach())}
+
+    def fit(self, x_train, epochs: int = 1, batch_size: int = 100,
+            binarization: str = "none", shuffle: bool = True,
+            verbose: bool = False):
+        from iwae_replication_project_tpu.data import epoch_batches
+        x_train = np.asarray(x_train, np.float32).reshape(len(x_train), -1)
+        history = {"loss": []}
+        for e in range(epochs):
+            losses = [self.train_step(torch.from_numpy(b))[self.loss_function]
+                      for b in epoch_batches(x_train, batch_size,
+                                             epoch=self.epoch + e, seed=self.seed,
+                                             binarization=binarization,
+                                             shuffle=shuffle)]
+            history["loss"].append(float(np.mean(losses)))
+            if verbose:
+                print(f"epoch {e + 1}/{epochs}: loss={history['loss'][-1]:.4f}")
+        return history
+
+    def get_NLL(self, x, k: int = 5000, chunk: int = 100):
+        """Streaming large-k NLL (no_grad, chunked like the JAX path)."""
+        if k % chunk != 0:
+            raise ValueError(f"chunk={chunk} must divide k={k}")
+        x = self._flatten(x)
+        with torch.no_grad():
+            m = torch.full((x.shape[0],), -float("inf"))
+            s = torch.zeros(x.shape[0])
+            for _ in range(k // chunk):
+                lw, _ = self._log_weights_aux(x, chunk)
+                cm = torch.maximum(m, lw.max(0).values)
+                s = s * torch.exp(m - cm) + torch.exp(lw - cm).sum(0)
+                m = cm
+            return -(torch.log(s / k) + m).mean()
+
+    @staticmethod
+    def _flatten(x):
+        if isinstance(x, np.ndarray):
+            x = torch.from_numpy(np.asarray(x, np.float32))
+        x = x.float()
+        return x.reshape(x.shape[0], -1)

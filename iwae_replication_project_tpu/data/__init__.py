@@ -1,0 +1,19 @@
+from iwae_replication_project_tpu.data.loaders import (
+    DATASETS,
+    Dataset,
+    load_dataset,
+    output_bias_from_pixel_means,
+)
+from iwae_replication_project_tpu.data.pipeline import (
+    epoch_batches,
+    Binarization,
+)
+
+__all__ = [
+    "DATASETS",
+    "Dataset",
+    "load_dataset",
+    "output_bias_from_pixel_means",
+    "epoch_batches",
+    "Binarization",
+]

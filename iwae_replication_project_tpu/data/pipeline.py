@@ -1,0 +1,41 @@
+"""Host-side batching pipeline: shuffling, binarization policies, DP-sharding.
+
+The reference trains with `keras.Model.fit(x_train, batch_size=100)` on the
+full in-memory tensor (experiment_example.py:82) and binarizes once at load.
+Here an epoch is a deterministic generator of device-ready batches:
+
+* **shuffle**: a fresh permutation per epoch from a numpy RNG seeded by
+  (seed, epoch) — reproducible and resumable;
+* **binarization** (`Binarization`): "none" keeps the loaded values (the
+  fixed-binarization protocol); "stochastic" redraws pixels ~ Bernoulli(x)
+  every time a batch is served (the Burda protocol — the discrepancy the PDF
+  flags on p.13, supported here as a first-class policy);
+* **drop-remainder** static batch shapes so jit never re-traces.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class Binarization:
+    NONE = "none"
+    STOCHASTIC = "stochastic"
+
+
+def epoch_batches(x: np.ndarray, batch_size: int, epoch: int, seed: int = 0,
+                  binarization: str = Binarization.NONE,
+                  shuffle: bool = True) -> Iterator[np.ndarray]:
+    """Yield ``[batch_size, 784]`` float32 batches for one pass over `x`."""
+    n = x.shape[0] - (x.shape[0] % batch_size)
+    rs = np.random.RandomState((seed * 100003 + epoch) % (2 ** 31))
+    idx = rs.permutation(x.shape[0])[:n] if shuffle else np.arange(n)
+    for start in range(0, n, batch_size):
+        batch = x[idx[start:start + batch_size]]
+        if binarization == Binarization.STOCHASTIC:
+            batch = (rs.uniform(size=batch.shape) < batch).astype(np.float32)
+        else:
+            batch = batch.astype(np.float32, copy=False)
+        yield batch

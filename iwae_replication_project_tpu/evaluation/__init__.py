@@ -1,0 +1,25 @@
+from iwae_replication_project_tpu.evaluation.metrics import (
+    batch_metrics,
+    streaming_log_px,
+    streaming_nll,
+    reconstruction_loss,
+    training_statistics,
+)
+from iwae_replication_project_tpu.evaluation.activity import (
+    posterior_mean_activity,
+    pca_eigenvalues,
+    active_units,
+    nll_without_inactive_units,
+)
+
+__all__ = [
+    "batch_metrics",
+    "streaming_log_px",
+    "streaming_nll",
+    "reconstruction_loss",
+    "training_statistics",
+    "posterior_mean_activity",
+    "pca_eigenvalues",
+    "active_units",
+    "nll_without_inactive_units",
+]

@@ -1,0 +1,108 @@
+"""Latent-unit activity diagnostics (Burda §C; flexible_IWAE.py:264-302,466-494).
+
+A unit is *active* if the across-data variance of its posterior mean exceeds a
+threshold (0.01). The reference estimates posterior means with 1000 separate
+full-test-set eager encoder passes (flexible_IWAE.py:270-273); here the same
+estimator runs as a `lax.scan` over sample-chunks of a single jitted program —
+the k fan-out axis does the sampling, an online sum does the averaging, so
+memory is O(chunk * B * d) and the MXU sees large batched matmuls.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from iwae_replication_project_tpu.models import iwae as model
+from iwae_replication_project_tpu.models.mlp import stochastic_block_apply
+from iwae_replication_project_tpu.ops import distributions as dist
+from iwae_replication_project_tpu.ops.logsumexp import (
+    online_logsumexp_finalize,
+    online_logsumexp_init,
+    online_logsumexp_update,
+)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_samples", "chunk"))
+def posterior_mean_activity(params, cfg: model.ModelConfig, key: jax.Array,
+                            x: jax.Array, n_samples: int = 1000,
+                            chunk: int = 10):
+    """MC posterior means E_q[h_i | x] -> per-unit variances and PCA eigenvalues.
+
+    Returns ``(variances, eigenvalues)``, tuples over stochastic layers with
+    entries of shape ``[n_latent_enc[i]]`` — the inputs to :func:`active_units`.
+    """
+    if n_samples % chunk != 0:
+        # largest divisor of n_samples not exceeding the requested chunk
+        chunk = max(d for d in range(1, min(chunk, n_samples) + 1)
+                    if n_samples % d == 0)
+
+    def body(sums, i):
+        h, _, _ = model.encode(params, cfg, jax.random.fold_in(key, i), x, chunk)
+        return tuple(s + jnp.sum(hi, axis=0) for s, hi in zip(sums, h)), None
+
+    init = tuple(jnp.zeros((x.shape[0], d)) for d in cfg.n_latent_enc)
+    sums, _ = lax.scan(body, init, jnp.arange(n_samples // chunk))
+    means = tuple(s / n_samples for s in sums)
+
+    variances = tuple(jnp.var(m, axis=0) for m in means)
+    eigenvalues = tuple(pca_eigenvalues(m) for m in means)
+    return variances, eigenvalues
+
+
+def pca_eigenvalues(data: jax.Array) -> jax.Array:
+    """Eigenvalues of the empirical covariance of ``[B, d]`` data
+    (flexible_IWAE.py:284-291)."""
+    centered = data - jnp.mean(data, axis=0)
+    cov = (centered.T @ centered) / data.shape[0]
+    return jnp.linalg.eigvalsh(cov)
+
+
+def active_units(variances, eigenvalues, threshold: float = 0.01
+                 ) -> Tuple[Tuple[jax.Array, ...], List[int], List[int]]:
+    """0/1 masks per layer + raw and PCA active-unit counts
+    (flexible_IWAE.py:294-302)."""
+    masks = tuple((v > threshold).astype(jnp.float32) for v in variances)
+    n_active = [int(jnp.sum(m)) for m in masks]
+    n_active_pca = [int(jnp.sum(e > threshold)) for e in eigenvalues]
+    return masks, n_active, n_active_pca
+
+
+@partial(jax.jit, static_argnames=("cfg", "k"))
+def _masked_log_weights(params, cfg: model.ModelConfig, key: jax.Array,
+                        x: jax.Array, masks, k: int) -> jax.Array:
+    """Log-weights with inactive latent coordinates zeroed after sampling,
+    densities evaluated at the masked values (flexible_IWAE.py:466-494)."""
+    keys = jax.random.split(key, cfg.n_stochastic)
+    mu, std = stochastic_block_apply(params["enc"][0], x, cfg.std_floor,
+                                     cfg.matmul_dtype)
+    h1 = dist.normal_sample(keys[0], mu, std, sample_shape=(k,)) * masks[0]
+    log_q = jnp.sum(dist.normal_log_prob(h1, mu, std), axis=-1)
+    h = [h1]
+    for i in range(1, cfg.n_stochastic):
+        mu, std = stochastic_block_apply(params["enc"][i], h[-1], cfg.std_floor,
+                                         cfg.matmul_dtype)
+        hi = dist.normal_sample(keys[i], mu, std) * masks[i]
+        log_q = log_q + jnp.sum(dist.normal_log_prob(hi, mu, std), axis=-1)
+        h.append(hi)
+    h = tuple(h)
+    return (model.log_prior(params, cfg, h)
+            + model.log_px_given_h(params, cfg, x, h[0]) - log_q)
+
+
+def nll_without_inactive_units(params, cfg: model.ModelConfig, key: jax.Array,
+                               x: jax.Array, masks, k: int = 5000,
+                               chunk: int = 100) -> jax.Array:
+    """-L_k with pruned latents — the 'cost of pruning' diagnostic (PDF §4.2.1),
+    streamed in k-chunks like the unpruned NLL."""
+    state = online_logsumexp_init((x.shape[0],))
+    for i in range(k // chunk):
+        lw = _masked_log_weights(params, cfg, jax.random.fold_in(key, i), x,
+                                 masks, chunk)
+        state = online_logsumexp_update(state, lw, axis=0)
+    return -jnp.mean(online_logsumexp_finalize(state, mean=True))

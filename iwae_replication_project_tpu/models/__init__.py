@@ -1,0 +1,21 @@
+from iwae_replication_project_tpu.models.iwae import (
+    ModelConfig,
+    init_params,
+    encode,
+    decode_probs,
+    log_weights,
+    log_weights_and_aux,
+    generate_x,
+    reconstruct_probs,
+)
+
+__all__ = [
+    "ModelConfig",
+    "init_params",
+    "encode",
+    "decode_probs",
+    "log_weights",
+    "log_weights_and_aux",
+    "generate_x",
+    "reconstruct_probs",
+]

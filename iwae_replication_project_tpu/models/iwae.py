@@ -1,0 +1,240 @@
+"""The IWAE model family: multi-layer stochastic encoder/decoder, purely functional.
+
+Capability parity with the reference's ``Encoder``/``Decoder``/``Flexible_Model``
+model core (flexible_IWAE.py:22-175, 327-351), re-designed for TPU:
+
+* parameters are plain pytrees; every entry point is a pure function of
+  ``(params, cfg, key, ...)`` — jit/grad/shard_map compose directly;
+* the k-sample axis is a leading array axis (``[k, B, d]``), so all dense math
+  is one large MXU matmul per layer, not per-sample work;
+* RNG is explicit: one key per stochastic draw via `jax.random.split`,
+  reproducing the independence structure of TFP's implicit sampling;
+* the dataset-dependent output bias is *passed in* as a value
+  (cf. the reference's network I/O inside the constructor at
+  flexible_IWAE.py:147-175 — lifted into the data layer here).
+
+Shapes follow the reference's convention: ``h[i]`` has shape
+``[k, B, n_latent_enc[i]]``, log-densities reduce to ``[k, B]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from iwae_replication_project_tpu.models import mlp
+from iwae_replication_project_tpu.ops import distributions as dist
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Static architecture hyperparameters (hashable -> usable as a jit static).
+
+    Mirrors the ctor lists of the reference (flexible_IWAE.py:178-202):
+    ``n_hidden_enc[i]``/``n_latent_enc[i]`` size encoder stochastic layer i;
+    the decoder lists run top-down (layer 0 maps the deepest latent toward x)
+    and ``n_latent_dec[-1]`` must equal ``x_dim``.
+    """
+
+    n_hidden_enc: Tuple[int, ...]
+    n_latent_enc: Tuple[int, ...]
+    n_hidden_dec: Tuple[int, ...]
+    n_latent_dec: Tuple[int, ...]
+    x_dim: int = 784
+    std_floor: float = dist.STD_FLOOR
+    # "clamp": sigmoid + reference prob clamp (bit-parity with flexible_IWAE.py:102);
+    # "logits": exact x*l - softplus(l) Bernoulli (faster, tighter).
+    likelihood: str = "clamp"
+    # None | "bfloat16" — matmul operand dtype; accumulation stays float32.
+    compute_dtype: Optional[str] = None
+
+    def __post_init__(self):
+        L = self.n_stochastic
+        if not (len(self.n_latent_enc) == L and len(self.n_hidden_dec) == L
+                and len(self.n_latent_dec) == L):
+            raise ValueError("encoder/decoder size lists must have equal length")
+        if self.n_latent_dec[-1] != self.x_dim:
+            raise ValueError(f"n_latent_dec[-1]={self.n_latent_dec[-1]} must equal x_dim={self.x_dim}")
+        if self.likelihood not in ("clamp", "logits"):
+            raise ValueError(f"unknown likelihood {self.likelihood!r}")
+
+    @property
+    def n_stochastic(self) -> int:
+        return len(self.n_hidden_enc)
+
+    @property
+    def matmul_dtype(self):
+        return jnp.bfloat16 if self.compute_dtype == "bfloat16" else None
+
+    @staticmethod
+    def two_layer(**kw) -> "ModelConfig":
+        """The flagship architecture of experiment_example.py:48-51."""
+        defaults = dict(n_hidden_enc=(200, 100), n_latent_enc=(100, 50),
+                        n_hidden_dec=(100, 200), n_latent_dec=(100, 784))
+        defaults.update(kw)
+        return ModelConfig(**defaults)
+
+    @staticmethod
+    def one_layer(**kw) -> "ModelConfig":
+        """The 1-stochastic-layer architecture of Burda Table 1 / PDF §3.3."""
+        defaults = dict(n_hidden_enc=(200,), n_latent_enc=(50,),
+                        n_hidden_dec=(200,), n_latent_dec=(784,))
+        defaults.update(kw)
+        return ModelConfig(**defaults)
+
+
+def init_params(key: jax.Array, cfg: ModelConfig,
+                output_bias: Optional[jax.Array] = None) -> Params:
+    """Build the parameter pytree. `output_bias` is the logit-of-pixel-mean
+    vector computed by the data layer (see data.bias; formula of
+    flexible_IWAE.py:174)."""
+    L = cfg.n_stochastic
+    keys = jax.random.split(key, 2 * L)
+    enc = []
+    in_dim = cfg.x_dim
+    for i in range(L):
+        enc.append(mlp.stochastic_block_init(keys[i], in_dim, cfg.n_hidden_enc[i],
+                                             cfg.n_latent_enc[i]))
+        in_dim = cfg.n_latent_enc[i]
+
+    dec = []
+    in_dim = cfg.n_latent_enc[-1]
+    for i in range(L - 1):
+        dec.append(mlp.stochastic_block_init(keys[L + i], in_dim, cfg.n_hidden_dec[i],
+                                             cfg.n_latent_dec[i]))
+        in_dim = cfg.n_latent_dec[i]
+    out = mlp.output_block_init(keys[2 * L - 1], in_dim, cfg.n_hidden_dec[-1],
+                                cfg.x_dim, out_bias=output_bias)
+    return {"enc": tuple(enc), "dec": tuple(dec), "out": out}
+
+
+# ---------------------------------------------------------------------------
+# Encoder
+# ---------------------------------------------------------------------------
+
+def encode(params: Params, cfg: ModelConfig, key: jax.Array, x: jax.Array, k: int,
+           stop_q_score: bool = False):
+    """Run the inference chain q(h|x) with a k-sample fan-out at the first layer.
+
+    Returns ``(h, log_q, q_last)`` where ``h`` is a tuple of ``[k, B, d_i]``
+    samples, ``log_q`` is ``[k, B]`` (sum over layers and latent dims), and
+    ``q_last`` is the (mu, std) of the final conditional — the analytic-ELBO
+    oracle needs it (cf. flexible_IWAE.py:75,443,457).
+
+    `stop_q_score=True` stops gradients through the *density parameters* inside
+    ``log q`` while keeping the pathwise dependence through the samples — the
+    score-term removal that DReG / sticking-the-landing estimators require
+    (Tucker et al. 2018, PAPERS.md).
+    """
+    dt = cfg.matmul_dtype
+    sg = jax.lax.stop_gradient if stop_q_score else (lambda t: t)
+    keys = jax.random.split(key, cfg.n_stochastic)
+    mu, std = mlp.stochastic_block_apply(params["enc"][0], x, cfg.std_floor, dt)
+    h1 = dist.normal_sample(keys[0], mu, std, sample_shape=(k,))
+    log_q = jnp.sum(dist.normal_log_prob(h1, sg(mu), sg(std)), axis=-1)
+    h = [h1]
+    q_last = (mu, std)
+    for i in range(1, cfg.n_stochastic):
+        mu, std = mlp.stochastic_block_apply(params["enc"][i], h[-1], cfg.std_floor, dt)
+        hi = dist.normal_sample(keys[i], mu, std)
+        log_q = log_q + jnp.sum(dist.normal_log_prob(hi, sg(mu), sg(std)), axis=-1)
+        h.append(hi)
+        q_last = (mu, std)
+    return tuple(h), log_q, q_last
+
+
+# ---------------------------------------------------------------------------
+# Decoder
+# ---------------------------------------------------------------------------
+
+def decode_logits(params: Params, cfg: ModelConfig, h1: jax.Array) -> jax.Array:
+    """Pixel logits from the bottom latent, ``[k, B, x_dim]``."""
+    return mlp.output_block_apply(params["out"], h1, cfg.matmul_dtype)
+
+
+def decode_probs(params: Params, cfg: ModelConfig, h1: jax.Array) -> jax.Array:
+    """Clamped pixel probabilities (reference parity, flexible_IWAE.py:101-102)."""
+    return dist.clamp_probs(jax.nn.sigmoid(decode_logits(params, cfg, h1)))
+
+
+def log_px_given_h(params: Params, cfg: ModelConfig, x: jax.Array,
+                   h1: jax.Array) -> jax.Array:
+    """``log p(x|h)`` summed over pixels -> ``[k, B]`` (flexible_IWAE.py:123-129)."""
+    logits = decode_logits(params, cfg, h1)
+    if cfg.likelihood == "clamp":
+        probs = dist.clamp_probs(jax.nn.sigmoid(logits))
+        lp = dist.bernoulli_log_prob(x, probs)
+    else:
+        lp = dist.bernoulli_log_prob_from_logits(x, logits)
+    return jnp.sum(lp, axis=-1)
+
+
+def log_prior(params: Params, cfg: ModelConfig, h: Tuple[jax.Array, ...]) -> jax.Array:
+    """``log p(h)``: standard-Normal on the deepest latent plus the decoder's
+    conditional chain down to h1 -> ``[k, B]`` (flexible_IWAE.py:134-142)."""
+    L = cfg.n_stochastic
+    log_p = jnp.sum(dist.standard_normal_log_prob(h[-1]), axis=-1)
+    for i in range(L - 1):
+        mu, std = mlp.stochastic_block_apply(params["dec"][i], h[L - 1 - i],
+                                             cfg.std_floor, cfg.matmul_dtype)
+        log_p = log_p + jnp.sum(dist.normal_log_prob(h[L - 2 - i], mu, std), axis=-1)
+    return log_p
+
+
+def generate_x(params: Params, cfg: ModelConfig, key: jax.Array,
+               h_top: jax.Array) -> jax.Array:
+    """Ancestral sampling from the deepest latent down, returning pixel probs
+    (flexible_IWAE.py:107-118)."""
+    L = cfg.n_stochastic
+    keys = jax.random.split(key, max(L - 1, 1))
+    h = h_top
+    for i in range(L - 1):
+        mu, std = mlp.stochastic_block_apply(params["dec"][i], h, cfg.std_floor,
+                                             cfg.matmul_dtype)
+        h = dist.normal_sample(keys[i], mu, std)
+    return decode_probs(params, cfg, h)
+
+
+# ---------------------------------------------------------------------------
+# Log-weights — the framework's spine
+# ---------------------------------------------------------------------------
+
+def log_weights_and_aux(params: Params, cfg: ModelConfig, key: jax.Array,
+                        x: jax.Array, k: int, stop_q_score: bool = False):
+    """One encoder+decoder pass -> ``[k, B]`` log importance weights plus every
+    intermediate any metric needs (the reference recomputes this pass up to 7x
+    per eval batch, flexible_IWAE.py:512-519 — here it is computed once).
+
+    ``log w = (log p(h) + log p(x|h)) - log q(h|x)`` (flexible_IWAE.py:343-349).
+    """
+    h, log_q, q_last = encode(params, cfg, key, x, k, stop_q_score=stop_q_score)
+    log_pxh_cond = log_px_given_h(params, cfg, x, h[0])
+    log_ph = log_prior(params, cfg, h)
+    log_w = log_ph + log_pxh_cond - log_q
+    aux = {
+        "h": h,
+        "log_q": log_q,
+        "log_px_given_h": log_pxh_cond,
+        "log_prior": log_ph,
+        "q_last": q_last,
+    }
+    return log_w, aux
+
+
+def log_weights(params: Params, cfg: ModelConfig, key: jax.Array, x: jax.Array,
+                k: int, stop_q_score: bool = False) -> jax.Array:
+    return log_weights_and_aux(params, cfg, key, x, k, stop_q_score=stop_q_score)[0]
+
+
+def reconstruct_probs(params: Params, cfg: ModelConfig, key: jax.Array,
+                      x: jax.Array) -> jax.Array:
+    """Encode with one sample, ancestral-decode — ``[1, B, x_dim]`` pixel probs
+    (flexible_IWAE.py:249-254)."""
+    k_enc, k_dec = jax.random.split(key)
+    h, _, _ = encode(params, cfg, k_enc, x, 1)
+    return generate_x(params, cfg, k_dec, h[-1])

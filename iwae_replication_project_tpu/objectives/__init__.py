@@ -1,0 +1,33 @@
+from iwae_replication_project_tpu.objectives.estimators import (
+    ObjectiveSpec,
+    OBJECTIVE_NAMES,
+    vae_bound,
+    iwae_bound,
+    miwae_bound,
+    ciwae_bound,
+    power_bound,
+    median_bound,
+    alpha_bound,
+    vae_v1_bound,
+    bound_from_log_weights,
+    objective_bound,
+)
+from iwae_replication_project_tpu.objectives.gradients import (
+    objective_value_and_grad,
+)
+
+__all__ = [
+    "ObjectiveSpec",
+    "OBJECTIVE_NAMES",
+    "vae_bound",
+    "iwae_bound",
+    "miwae_bound",
+    "ciwae_bound",
+    "power_bound",
+    "median_bound",
+    "alpha_bound",
+    "vae_v1_bound",
+    "bound_from_log_weights",
+    "objective_bound",
+    "objective_value_and_grad",
+]

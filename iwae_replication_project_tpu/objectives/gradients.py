@@ -1,0 +1,109 @@
+"""Gradient estimators, including the ones that differ from naive autodiff.
+
+Standard objectives (VAE/IWAE/MIWAE/CIWAE/L_*) are just
+``value_and_grad(-bound)``. Three estimators from the baseline's extended
+configs (BASELINE.json configs 4-5; papers in PAPERS.md) prescribe *different
+gradients for the same IWAE-family bound*:
+
+* **STL** (sticking the landing, Roeder et al. 2017): drop the score term of
+  ``log q`` — pathwise-only encoder gradient with cotangent ``w~`` (the
+  normalized importance weights).
+* **DReG** (doubly-reparameterized, Tucker et al. 2018): encoder cotangent
+  ``w~^2`` on the score-stopped graph; decoder keeps the standard ``w~``.
+* **PIWAE** (Rainforth et al. 2018): decoder trained on the full
+  ``k``-sample IWAE bound, encoder on the MIWAE(k1, k2) bound.
+
+All three are realized as explicit VJP cotangents on the ``[k, B]`` log-weight
+tensor: one forward pass, the reducer's analytic derivative as cotangent, and
+(where encoder/decoder disagree) per-subtree selection of two backward passes.
+This keeps the estimator code independent of the network, exactly like the
+bound reducers.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from iwae_replication_project_tpu.models import iwae as model
+from iwae_replication_project_tpu.objectives import estimators as est
+
+
+def _normalized_weights(log_w: jax.Array) -> jax.Array:
+    """``w~ = softmax_k(log w)``, stop-gradded — the self-normalized weights."""
+    return jax.lax.stop_gradient(jax.nn.softmax(log_w, axis=0))
+
+
+def _select(tree_a, tree_b, take_enc_from_a: bool):
+    """Take the encoder subtree from one grad pytree and the rest from the other."""
+    enc_src, rest_src = (tree_a, tree_b) if take_enc_from_a else (tree_b, tree_a)
+    out = dict(rest_src)
+    out["enc"] = enc_src["enc"]
+    return out
+
+
+def objective_value_and_grad(spec: est.ObjectiveSpec, params, cfg, key, x
+                             ) -> Tuple[jax.Array, dict]:
+    """``(bound, d bound / d params)`` for any objective, special-casing the
+    modified-gradient estimators. Train steps negate for descent."""
+    name = spec.name
+    if name in ("DReG", "STL"):
+        return _dreg_stl_value_and_grad(spec, params, cfg, key, x, dreg=name == "DReG")
+    if name == "PIWAE":
+        return _piwae_value_and_grad(spec, params, cfg, key, x)
+
+    def bound_fn(p):
+        log_w, aux = model.log_weights_and_aux(p, cfg, key, x, spec.k)
+        return est.bound_from_log_weights(spec, log_w, aux)
+
+    return jax.value_and_grad(bound_fn)(params)
+
+
+def _dreg_stl_value_and_grad(spec, params, cfg, key, x, dreg: bool):
+    """One score-stopped forward; cotangent w~ (STL) or per-part w~/w~^2 (DReG).
+
+    The IWAE bound's derivative wrt log w_i is ``w~_i / B``; DReG replaces the
+    encoder's with ``w~_i^2 / B`` on the score-stopped graph.
+    """
+    B = x.shape[0]
+
+    def log_w_fn(p):
+        return model.log_weights(p, cfg, key, x, spec.k, stop_q_score=True)
+
+    log_w, vjp = jax.vjp(log_w_fn, params)
+    w_tilde = _normalized_weights(log_w)
+    bound = est.iwae_bound(log_w)
+
+    if not dreg:
+        (grads,) = vjp(w_tilde / B)
+        return bound, grads
+
+    (g_enc,) = vjp(jnp.square(w_tilde) / B)
+    (g_dec,) = vjp(w_tilde / B)
+    return bound, _select(g_enc, g_dec, take_enc_from_a=True)
+
+
+def _piwae_value_and_grad(spec, params, cfg, key, x):
+    """Encoder grad from MIWAE(k1,k2), decoder grad from IWAE(k): one forward,
+    two analytic cotangents on the shared log-weight graph."""
+    B = x.shape[0]
+
+    def log_w_fn(p):
+        return model.log_weights(p, cfg, key, x, spec.k)
+
+    log_w, vjp = jax.vjp(log_w_fn, params)
+    bound = est.iwae_bound(log_w)
+
+    # d IWAE / d log_w = softmax over the full k axis, / B.
+    ct_dec = _normalized_weights(log_w) / B
+    # d MIWAE / d log_w = softmax within each k1-group, / (k2 * B).
+    k2 = spec.k2
+    grouped = log_w.reshape(k2, spec.k // k2, *log_w.shape[1:])
+    ct_enc = (jax.lax.stop_gradient(jax.nn.softmax(grouped, axis=1))
+              .reshape(log_w.shape) / (k2 * B))
+
+    (g_dec,) = vjp(ct_dec)
+    (g_enc,) = vjp(ct_enc)
+    return bound, _select(g_enc, g_dec, take_enc_from_a=True)

@@ -1,0 +1,39 @@
+"""Numerics layer: distributions and stable log-space reductions.
+
+Replaces the reference's TFP dependency (flexible_IWAE.py:3,17) with two
+closed-form log-probs and hand-rolled, streaming-capable logsumexp reductions.
+"""
+
+from iwae_replication_project_tpu.ops.distributions import (
+    normal_log_prob,
+    normal_sample,
+    normal_kl_standard,
+    bernoulli_log_prob,
+    clamp_probs,
+    PROB_CLAMP_SCALE,
+    PROB_CLAMP_SHIFT,
+    STD_FLOOR,
+)
+from iwae_replication_project_tpu.ops.logsumexp import (
+    logmeanexp,
+    logsumexp,
+    online_logsumexp_init,
+    online_logsumexp_update,
+    online_logsumexp_finalize,
+)
+
+__all__ = [
+    "normal_log_prob",
+    "normal_sample",
+    "normal_kl_standard",
+    "bernoulli_log_prob",
+    "clamp_probs",
+    "PROB_CLAMP_SCALE",
+    "PROB_CLAMP_SHIFT",
+    "STD_FLOOR",
+    "logmeanexp",
+    "logsumexp",
+    "online_logsumexp_init",
+    "online_logsumexp_update",
+    "online_logsumexp_finalize",
+]

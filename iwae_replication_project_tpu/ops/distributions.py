@@ -1,0 +1,82 @@
+"""Closed-form distribution numerics (no TFP dependency).
+
+The reference relies on ``tfd.Normal``/``tfd.Bernoulli`` (flexible_IWAE.py:37,103).
+Only two log-densities are ever needed, so they are implemented directly as pure
+functions that XLA can fuse into the surrounding matmuls. Numerical-parity
+constants from the reference:
+
+* std floor ``1e-6`` added to the exp-activated scale head (flexible_IWAE.py:37)
+* pixel-probability clamp ``p * (1 - 1e-6) + 1e-7`` (flexible_IWAE.py:102,126)
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+# Reference parity constants (flexible_IWAE.py:37,102).
+STD_FLOOR = 1e-6
+PROB_CLAMP_SCALE = 1.0 - 1e-6
+PROB_CLAMP_SHIFT = 1e-7
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+def normal_sample(key: jax.Array, mu: jax.Array, std: jax.Array,
+                  sample_shape: tuple = ()) -> jax.Array:
+    """Reparameterized draw ``mu + std * eps`` (pathwise estimator, PDF p.5).
+
+    `sample_shape` is prepended, matching ``tfd.Normal.sample(n)`` semantics used
+    for the k-sample fan-out at flexible_IWAE.py:59.
+    """
+    shape = sample_shape + jnp.broadcast_shapes(jnp.shape(mu), jnp.shape(std))
+    eps = jax.random.normal(key, shape, dtype=jnp.result_type(jnp.asarray(mu).dtype,
+                                                              jnp.asarray(std).dtype))
+    return mu + std * eps
+
+
+def normal_log_prob(x: jax.Array, mu: jax.Array, std: jax.Array) -> jax.Array:
+    """Elementwise diagonal-Normal log density."""
+    z = (x - mu) / std
+    return -0.5 * z * z - jnp.log(std) - _HALF_LOG_2PI
+
+
+def standard_normal_log_prob(x: jax.Array) -> jax.Array:
+    """log N(x; 0, 1) — the top-of-chain prior (flexible_IWAE.py:135-136)."""
+    return -0.5 * x * x - _HALF_LOG_2PI
+
+
+def normal_kl_standard(mu: jax.Array, std: jax.Array) -> jax.Array:
+    """Closed-form KL(N(mu, std) || N(0, 1)), elementwise.
+
+    The analytic oracle used by the reference's ``get_L_V1`` cross-check
+    (flexible_IWAE.py:457): ``-0.5 * (1 + 2 log std - mu^2 - std^2)``.
+    """
+    return -0.5 * (1.0 + 2.0 * jnp.log(std) - mu * mu - std * std)
+
+
+def clamp_probs(probs: jax.Array) -> jax.Array:
+    """Reference pixel-probability clamp keeping Bernoulli log-probs finite."""
+    return probs * PROB_CLAMP_SCALE + PROB_CLAMP_SHIFT
+
+
+def bernoulli_log_prob(x: jax.Array, probs: jax.Array) -> jax.Array:
+    """Elementwise Bernoulli log pmf with {0,1} or relaxed x in [0,1].
+
+    ``x log p + (1-x) log(1-p)`` — the same expression TFP evaluates for float
+    targets, which the reference applies to stochastically-binarized pixels too.
+    Callers clamp `probs` first (see :func:`clamp_probs`).
+    """
+    return x * jnp.log(probs) + (1.0 - x) * jnp.log1p(-probs)
+
+
+def bernoulli_log_prob_from_logits(x: jax.Array, logits: jax.Array) -> jax.Array:
+    """Numerically-exact Bernoulli log pmf from logits.
+
+    ``x*l - softplus(l)`` — avoids the sigmoid→log round-trip entirely. Used by
+    the fast path; the clamped-probs form above exists for bitwise parity with
+    the reference's sigmoid-output head (flexible_IWAE.py:94,102).
+    """
+    return x * logits - jax.nn.softplus(logits)

@@ -1,0 +1,101 @@
+"""Stable log-space reductions, including a streaming (online) logsumexp.
+
+The reference hand-rolls max-subtracted logmeanexp (flexible_IWAE.py:363-370) and
+materializes full ``[k, B, 784]`` tensors even at k=5000 evaluation
+(flexible_IWAE.py:463). Here the same reduction is also available as an *online*
+recurrence (running max + rescaled sum — the online-softmax/ring-attention
+trick), so large-k evaluation runs as a ``lax.scan`` over k-chunks with O(chunk)
+memory, and as a *distributed* reduction over a sharded k axis (pmax + psum).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def logsumexp(log_w: jax.Array, axis: int = 0) -> jax.Array:
+    """Max-subtracted logsumexp along `axis`."""
+    m = lax.stop_gradient(jnp.max(log_w, axis=axis, keepdims=True))
+    m = jnp.where(jnp.isfinite(m), m, 0.0)  # all -inf column -> return -inf, not nan
+    out = jnp.log(jnp.sum(jnp.exp(log_w - m), axis=axis)) + jnp.squeeze(m, axis=axis)
+    return out
+
+
+def logmeanexp(log_w: jax.Array, axis: int = 0) -> jax.Array:
+    """``log mean exp`` along `axis` — the IWAE bound core (flexible_IWAE.py:368-369)."""
+    n = log_w.shape[axis]
+    return logsumexp(log_w, axis=axis) - jnp.log(float(n))
+
+
+class OnlineLSE(NamedTuple):
+    """Carry for the streaming logsumexp recurrence.
+
+    `m` is the running max, `s` the sum of ``exp(x - m)`` seen so far, `n` the
+    element count. Merging two states is associative, so the same update works
+    for a `lax.scan` over chunks and for a tree/ring reduction over devices.
+    """
+
+    m: jax.Array
+    s: jax.Array
+    n: jax.Array
+
+
+def online_logsumexp_init(shape, dtype=jnp.float32) -> OnlineLSE:
+    return OnlineLSE(
+        m=jnp.full(shape, -jnp.inf, dtype=dtype),
+        s=jnp.zeros(shape, dtype=dtype),
+        n=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def online_logsumexp_update(state: OnlineLSE, log_w: jax.Array, axis: int = 0) -> OnlineLSE:
+    """Fold a chunk of log-weights (reduced along `axis`) into the state."""
+    chunk_m = jnp.max(log_w, axis=axis)
+    new_m = jnp.maximum(state.m, chunk_m)
+    safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    scaled_old = state.s * jnp.exp(state.m - safe_m)
+    chunk_s = jnp.sum(jnp.exp(log_w - jnp.expand_dims(safe_m, axis)), axis=axis)
+    return OnlineLSE(m=new_m, s=scaled_old + chunk_s,
+                     n=state.n + jnp.int32(log_w.shape[axis]))
+
+
+def online_logsumexp_merge(a: OnlineLSE, b: OnlineLSE) -> OnlineLSE:
+    """Associative merge of two partial states (device-level reduction)."""
+    new_m = jnp.maximum(a.m, b.m)
+    safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    return OnlineLSE(
+        m=new_m,
+        s=a.s * jnp.exp(a.m - safe_m) + b.s * jnp.exp(b.m - safe_m),
+        n=a.n + b.n,
+    )
+
+
+def online_logsumexp_finalize(state: OnlineLSE, mean: bool = False) -> jax.Array:
+    safe_m = jnp.where(jnp.isfinite(state.m), state.m, 0.0)
+    out = jnp.log(state.s) + safe_m
+    if mean:
+        out = out - jnp.log(state.n.astype(out.dtype))
+    return out
+
+
+def streaming_logmeanexp(log_w_fn, k: int, chunk: int, shape, dtype=jnp.float32) -> jax.Array:
+    """``logmeanexp`` over k samples produced chunk-at-a-time by `log_w_fn(i)`.
+
+    `log_w_fn(chunk_index)` must return a ``[chunk, *shape]`` block of
+    log-weights. Memory is O(chunk), not O(k) — this is how k=5000 NLL
+    evaluation (flexible_IWAE.py:463) fits on-chip.
+    """
+    if k % chunk != 0:
+        raise ValueError(f"k={k} must be divisible by chunk={chunk}")
+    n_chunks = k // chunk
+
+    def body(state, i):
+        return online_logsumexp_update(state, log_w_fn(i), axis=0), None
+
+    init = online_logsumexp_init(shape, dtype)
+    state, _ = lax.scan(body, init, jnp.arange(n_chunks))
+    return online_logsumexp_finalize(state, mean=True)

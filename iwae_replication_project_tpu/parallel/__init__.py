@@ -1,0 +1,16 @@
+from iwae_replication_project_tpu.parallel.mesh import make_mesh, MeshAxes
+from iwae_replication_project_tpu.parallel.dp import (
+    make_parallel_train_step,
+    shard_batch,
+    distributed_logmeanexp,
+)
+from iwae_replication_project_tpu.parallel.auto import make_pjit_train_step
+
+__all__ = [
+    "make_mesh",
+    "MeshAxes",
+    "make_parallel_train_step",
+    "shard_batch",
+    "distributed_logmeanexp",
+    "make_pjit_train_step",
+]

@@ -1,0 +1,42 @@
+"""Compiler-sharded (pjit) training path.
+
+The idiomatic modern alternative to the explicit shard_map step in
+parallel.dp: annotate the batch ``P('dp')``, leave parameters replicated (these
+MLPs are far below the size where tensor parallelism pays), and let XLA's SPMD
+partitioner insert the gradient all-reduce. Useful both as a cross-check of the
+explicit path (tests assert they match) and as the zero-boilerplate default.
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from iwae_replication_project_tpu.models import iwae as model
+from iwae_replication_project_tpu.objectives import ObjectiveSpec
+from iwae_replication_project_tpu.parallel.mesh import AXES
+from iwae_replication_project_tpu.training.train_step import make_train_step_fn
+
+
+def make_pjit_train_step(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
+                         optimizer: optax.GradientTransformation | None = None,
+                         donate: bool = True):
+    """jit with in/out shardings: state replicated, batch sharded over dp.
+
+    Returns ``(step, place_state, place_batch)`` — the placement helpers pin
+    inputs to the mesh so XLA partitions instead of transferring.
+    """
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P(AXES.dp))
+    step = jax.jit(make_train_step_fn(spec, cfg, optimizer),
+                   in_shardings=(repl, batch_sh), out_shardings=(repl, repl),
+                   donate_argnums=(0,) if donate else ())
+
+    def place_state(state):
+        return jax.device_put(state, repl)
+
+    def place_batch(batch):
+        return jax.device_put(batch, batch_sh)
+
+    return step, place_state, place_batch

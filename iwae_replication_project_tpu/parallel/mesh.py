@@ -1,0 +1,51 @@
+"""Device mesh construction for the framework's parallelism axes.
+
+The reference has no parallelism at all (single eager device,
+experiment_example.py:82); SURVEY.md §2.5 records the TPU-native equivalents
+built here:
+
+* ``dp`` — data parallelism: the batch axis is sharded across devices and
+  gradients are mean-reduced over ICI (`psum`/`pmean`).
+* ``sp`` — *sample* parallelism: the K importance-sample axis (the reference's
+  scaling axis, k up to 5000 at eval) is sharded, with the IWAE logmeanexp
+  computed as a distributed online reduction (`pmax` + `psum`) — the analog of
+  sequence/context parallelism for this model family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxes:
+    dp: str = "dp"
+    sp: str = "sp"
+
+
+AXES = MeshAxes()
+
+
+def make_mesh(dp: Optional[int] = None, sp: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A ``(dp, sp)`` mesh. With `dp=None`, dp absorbs all remaining devices.
+
+    ICI note: adjacent mesh positions map to ICI-adjacent devices on TPU, so
+    the high-traffic axis (sp's logmeanexp reductions during eval; dp's gradient
+    psum during training) stays on-torus.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if dp is None:
+        if n % sp != 0:
+            raise ValueError(f"sp={sp} must divide device count {n}")
+        dp = n // sp
+    if dp * sp > n:
+        raise ValueError(f"mesh {dp}x{sp} needs {dp * sp} devices, have {n}")
+    grid = np.asarray(devs[: dp * sp]).reshape(dp, sp)
+    return Mesh(grid, (AXES.dp, AXES.sp))

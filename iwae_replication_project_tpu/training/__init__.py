@@ -1,0 +1,19 @@
+from iwae_replication_project_tpu.training.train_step import (
+    TrainState,
+    create_train_state,
+    make_train_step,
+    make_adam,
+)
+from iwae_replication_project_tpu.training.schedule import (
+    burda_stage_lr,
+    burda_stages,
+)
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+    "make_adam",
+    "burda_stage_lr",
+    "burda_stages",
+]

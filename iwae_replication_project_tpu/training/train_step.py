@@ -1,0 +1,85 @@
+"""The jitted training step and its state.
+
+Replaces the reference's eager Keras ``train_step`` (flexible_IWAE.py:221-247)
+with a pure ``(state, batch) -> (state, metrics)`` function compiled once by XLA.
+Objective dispatch happens at *trace* time (the objective is a static spec), so
+there is no branching inside the compiled graph. Adam uses the reference's
+nonstandard ``eps=1e-4`` (experiment_example.py:39, matching Burda).
+
+The learning rate is an injected hyperparameter, so the 8-stage schedule can
+retune it *without* resetting Adam moments — the same behavior as the reference
+mutating ``optimizer.learning_rate`` across stages (experiment_example.py:76).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from iwae_replication_project_tpu.models import iwae as model
+from iwae_replication_project_tpu.objectives import ObjectiveSpec, objective_value_and_grad
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    key: jax.Array
+    step: jax.Array  # per-batch counter (the reference's misnamed `epoch`, flexible_IWAE.py:245)
+
+
+def make_adam(lr: float = 1e-3, b1: float = 0.9, b2: float = 0.999,
+              eps: float = 1e-4) -> optax.GradientTransformation:
+    return optax.inject_hyperparams(optax.adam)(learning_rate=lr, b1=b1, b2=b2, eps=eps)
+
+
+def create_train_state(key: jax.Array, cfg: model.ModelConfig,
+                       output_bias=None, lr: float = 1e-3,
+                       optimizer: optax.GradientTransformation | None = None) -> TrainState:
+    k_init, k_train = jax.random.split(key)
+    params = model.init_params(k_init, cfg, output_bias=output_bias)
+    opt = optimizer if optimizer is not None else make_adam(lr)
+    return TrainState(params=params, opt_state=opt.init(params), key=k_train,
+                      step=jnp.zeros((), jnp.int32))
+
+
+def set_learning_rate(state: TrainState, lr: float) -> TrainState:
+    """Stage-boundary LR update, preserving Adam moments.
+
+    Rebuilds the hyperparams mapping instead of assigning into it — the old
+    TrainState may still be referenced (rollback, pending checkpoint) and must
+    keep its LR.
+    """
+    opt_state = state.opt_state
+    new_hp = dict(opt_state.hyperparams)
+    new_hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
+    return state._replace(opt_state=opt_state._replace(hyperparams=new_hp))
+
+
+def make_train_step_fn(spec: ObjectiveSpec, cfg: model.ModelConfig,
+                       optimizer: optax.GradientTransformation | None = None
+                       ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, Dict[str, jax.Array]]]:
+    """The raw (un-jitted) step — jit it yourself, or via make_train_step /
+    parallel.auto.make_pjit_train_step."""
+    opt = optimizer if optimizer is not None else make_adam()
+
+    def step(state: TrainState, batch: jax.Array):
+        key, subkey = jax.random.split(state.key)
+        bound, grads = objective_value_and_grad(spec, state.params, cfg, subkey, batch)
+        neg_grads = jax.tree.map(jnp.negative, grads)  # maximize the bound
+        updates, opt_state = opt.update(neg_grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": -bound, spec.name: -bound}
+        return TrainState(params, opt_state, key, state.step + 1), metrics
+
+    return step
+
+
+def make_train_step(spec: ObjectiveSpec, cfg: model.ModelConfig,
+                    optimizer: optax.GradientTransformation | None = None,
+                    donate: bool = True):
+    """Build the jitted single-device step; see parallel.dp for the sharded one."""
+    step = make_train_step_fn(spec, cfg, optimizer)
+    return jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,15 @@
+from iwae_replication_project_tpu.utils.config import ExperimentConfig
+from iwae_replication_project_tpu.utils.logging import MetricsLogger
+from iwae_replication_project_tpu.utils.checkpoint import (
+    save_checkpoint,
+    restore_latest,
+    latest_step,
+)
+
+__all__ = [
+    "ExperimentConfig",
+    "MetricsLogger",
+    "save_checkpoint",
+    "restore_latest",
+    "latest_step",
+]

@@ -1,0 +1,81 @@
+"""Checkpoint / resume via Orbax (SURVEY.md §5: the reference only has
+per-stage ``save_weights`` "just in case it stops" with no restore logic,
+experiment_example.py:95; here a checkpoint is the full resumable state).
+
+A checkpoint = model params + optimizer state + RNG key + step counter +
+stage index (+ the experiment config JSON), written atomically by Orbax with
+retention of the newest `keep` steps.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from iwae_replication_project_tpu.training.train_step import TrainState
+
+
+def _manager(directory: str, keep: int = 3) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
+    )
+
+
+def save_checkpoint(directory: str, step: int, state: TrainState, stage: int,
+                    config_json: str = "", keep: int = 3) -> None:
+    mgr = _manager(directory, keep)
+    payload = {
+        "params": state.params,
+        "opt_state": state.opt_state,
+        "key": state.key,
+        "step": state.step,
+    }
+    mgr.save(step, args=ocp.args.Composite(
+        state=ocp.args.StandardSave(payload),
+        meta=ocp.args.JsonSave({"config": config_json, "stage": stage}),
+    ))
+    mgr.wait_until_finished()
+    mgr.close()
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    mgr = _manager(directory)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore_latest(directory: str, template: TrainState
+                   ) -> Optional[Tuple[int, TrainState, int]]:
+    """Restore ``(step, state, stage)`` from the newest checkpoint, or None.
+
+    `template` supplies the pytree structure/dtypes (an identically-constructed
+    fresh TrainState).
+    """
+    step = latest_step(directory)
+    if step is None:
+        return None
+    mgr = _manager(directory)
+    tmpl = {
+        "params": template.params,
+        "opt_state": template.opt_state,
+        "key": template.key,
+        "step": template.step,
+    }
+    restored = mgr.restore(step, args=ocp.args.Composite(
+        state=ocp.args.StandardRestore(tmpl),
+        meta=ocp.args.JsonRestore(),
+    ))
+    mgr.close()
+    payload = restored["state"]
+    stage = int(restored["meta"]["stage"])
+    state = TrainState(params=payload["params"], opt_state=payload["opt_state"],
+                       key=payload["key"], step=payload["step"])
+    return step, state, stage

@@ -1,0 +1,148 @@
+"""Experiment configuration: one dataclass + CLI binding.
+
+The reference has no config system — hyperparameters are ctor kwargs plus
+constants edited in the script (experiment_example.py:35-58). This dataclass
+covers that whole surface, adds the TPU-execution knobs (mesh, dtype,
+backend), and round-trips to JSON for checkpoint metadata.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from typing import Optional, Tuple
+
+from iwae_replication_project_tpu.models.iwae import ModelConfig
+from iwae_replication_project_tpu.objectives.estimators import ObjectiveSpec
+
+
+@dataclasses.dataclass
+class ExperimentConfig:
+    # data (experiment_example.py:25-31)
+    dataset: str = "binarized_mnist"
+    data_dir: str = "data"
+    allow_synthetic: bool = True
+
+    # architecture (experiment_example.py:48-51 defaults: the 2L flagship)
+    n_hidden_encoder: Tuple[int, ...] = (200, 100)
+    n_hidden_decoder: Tuple[int, ...] = (100, 200)
+    n_latent_encoder: Tuple[int, ...] = (100, 50)
+    n_latent_decoder: Tuple[int, ...] = (100, 784)
+
+    # objective (experiment_example.py:54-58)
+    loss_function: str = "IWAE"
+    k: int = 50
+    p: float = 1.0
+    alpha: float = 1.0
+    beta: float = 0.5
+    k2: int = 1  # MIWAE/PIWAE outer count
+
+    # training (experiment_example.py:35-40; PDF §3.4)
+    batch_size: int = 100
+    n_stages: int = 8
+    adam_eps: float = 1e-4
+    seed: int = 0
+
+    # evaluation (flexible_IWAE.py:496-526)
+    eval_k: int = 50
+    nll_k: int = 5000
+    nll_chunk: int = 100
+    eval_batch_size: int = 100
+    activity_samples: int = 1000
+
+    # execution
+    backend: str = "jax"          # "jax" | "torch" (eager CPU oracle) | "tf2" (gated)
+    mesh_dp: Optional[int] = None  # None -> all devices
+    mesh_sp: int = 1
+    compute_dtype: Optional[str] = None  # None | "bfloat16"
+    likelihood: str = "clamp"
+
+    # observability / persistence
+    log_dir: str = "runs"
+    checkpoint_dir: str = "checkpoints"
+    checkpoint_keep: int = 3
+    resume: bool = True
+
+    def model_config(self) -> ModelConfig:
+        return ModelConfig(
+            n_hidden_enc=tuple(self.n_hidden_encoder),
+            n_latent_enc=tuple(self.n_latent_encoder),
+            n_hidden_dec=tuple(self.n_hidden_decoder),
+            n_latent_dec=tuple(self.n_latent_decoder),
+            likelihood=self.likelihood,
+            compute_dtype=self.compute_dtype,
+        )
+
+    def objective_spec(self) -> ObjectiveSpec:
+        return ObjectiveSpec(name=self.loss_function, k=self.k, p=self.p,
+                             alpha=self.alpha, beta=self.beta, k2=self.k2)
+
+    def run_name(self) -> str:
+        """`IWAE-2L-k_50`-style tag (cf. experiment_example.py:67,95)."""
+        return f"{self.loss_function}-{len(self.n_hidden_encoder)}L-k_{self.k}"
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "ExperimentConfig":
+        d = json.loads(s)
+        for key in ("n_hidden_encoder", "n_hidden_decoder", "n_latent_encoder",
+                    "n_latent_decoder"):
+            d[key] = tuple(d[key])
+        return ExperimentConfig(**d)
+
+
+def _int_list(s: str) -> Tuple[int, ...]:
+    return tuple(int(v) for v in s.split(",") if v)
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="iwae_replication_project_tpu",
+        description="TPU-native IWAE framework experiment runner")
+    d = ExperimentConfig()
+    ap.add_argument("--config", type=str, default=None,
+                    help="JSON config file; CLI flags override it")
+    ap.add_argument("--dataset", default=None, type=str)
+    ap.add_argument("--data-dir", dest="data_dir", default=None, type=str)
+    ap.add_argument("--loss-function", dest="loss_function", default=None, type=str)
+    ap.add_argument("--k", default=None, type=int)
+    ap.add_argument("--k2", default=None, type=int)
+    ap.add_argument("--p", default=None, type=float)
+    ap.add_argument("--alpha", default=None, type=float)
+    ap.add_argument("--beta", default=None, type=float)
+    ap.add_argument("--batch-size", dest="batch_size", default=None, type=int)
+    ap.add_argument("--n-stages", dest="n_stages", default=None, type=int)
+    ap.add_argument("--seed", default=None, type=int)
+    ap.add_argument("--backend", default=None, type=str)
+    ap.add_argument("--mesh-dp", dest="mesh_dp", default=None, type=int)
+    ap.add_argument("--mesh-sp", dest="mesh_sp", default=None, type=int)
+    ap.add_argument("--compute-dtype", dest="compute_dtype", default=None, type=str)
+    ap.add_argument("--likelihood", default=None, type=str)
+    ap.add_argument("--log-dir", dest="log_dir", default=None, type=str)
+    ap.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None, type=str)
+    ap.add_argument("--no-resume", dest="resume", action="store_false", default=None)
+    ap.add_argument("--hidden-encoder", dest="n_hidden_encoder", default=None, type=_int_list)
+    ap.add_argument("--hidden-decoder", dest="n_hidden_decoder", default=None, type=_int_list)
+    ap.add_argument("--latent-encoder", dest="n_latent_encoder", default=None, type=_int_list)
+    ap.add_argument("--latent-decoder", dest="n_latent_decoder", default=None, type=_int_list)
+    ap.add_argument("--eval-k", dest="eval_k", default=None, type=int)
+    ap.add_argument("--nll-k", dest="nll_k", default=None, type=int)
+    return ap
+
+
+def config_from_args(argv=None) -> ExperimentConfig:
+    ap = build_argparser()
+    ns = ap.parse_args(argv)
+    if ns.config:
+        with open(ns.config) as f:
+            cfg = ExperimentConfig.from_json(f.read())
+    else:
+        cfg = ExperimentConfig()
+    for field in dataclasses.fields(ExperimentConfig):
+        v = getattr(ns, field.name, None)
+        if v is not None:
+            setattr(cfg, field.name, v)
+    return cfg

@@ -1,0 +1,32 @@
+"""Test harness: fake 8-device CPU platform (SURVEY.md §4 test plan).
+
+Must set the XLA flags before jax initializes its backends, hence the
+environment mutation at module import time, before any jax import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The axon TPU plugin overrides JAX_PLATFORMS at import time; force CPU via the
+# config API (must happen before the first backend initialization).
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)

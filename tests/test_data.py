@@ -1,0 +1,130 @@
+"""Data-layer tests: loaders, binarization policies, bias init, batching."""
+
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu.data import (
+    Binarization,
+    epoch_batches,
+    load_dataset,
+    output_bias_from_pixel_means,
+)
+
+
+class TestLoaders:
+    @pytest.mark.parametrize("name", ["binarized_mnist", "mnist", "fashion_mnist",
+                                      "omniglot"])
+    def test_synthetic_fallback_shapes(self, name, tmp_path):
+        ds = load_dataset(name, data_dir=str(tmp_path), allow_synthetic=True)
+        assert ds.x_train.shape[1] == 784
+        assert ds.x_test.shape[1] == 784
+        assert ds.x_train.dtype == np.float32
+        assert 0.0 <= ds.x_train.min() and ds.x_train.max() <= 1.0
+        assert ds.bias_means.shape == (784,)
+
+    def test_unknown_dataset_raises(self):
+        with pytest.raises(ValueError):
+            load_dataset("cifar10")
+
+    def test_no_synthetic_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            load_dataset("mnist", data_dir=str(tmp_path), allow_synthetic=False)
+
+    def test_binarization_policy(self, tmp_path):
+        assert load_dataset("binarized_mnist", data_dir=str(tmp_path)).binarization == "none"
+        assert load_dataset("mnist", data_dir=str(tmp_path)).binarization == "stochastic"
+
+    def test_npz_loading(self, tmp_path):
+        rs = np.random.RandomState(0)
+        x_train = rs.randint(0, 256, (20, 28, 28)).astype(np.uint8)
+        x_test = rs.randint(0, 256, (10, 28, 28)).astype(np.uint8)
+        np.savez(tmp_path / "mnist.npz", x_train=x_train, x_test=x_test)
+        ds = load_dataset("mnist", data_dir=str(tmp_path), allow_synthetic=False)
+        assert ds.x_train.shape == (20, 784)
+        assert ds.x_train.max() <= 1.0
+        np.testing.assert_allclose(ds.bias_means, ds.x_train.mean(0))
+
+    def test_synthetic_deterministic(self, tmp_path):
+        a = load_dataset("mnist", data_dir=str(tmp_path))
+        b = load_dataset("mnist", data_dir=str(tmp_path))
+        np.testing.assert_array_equal(a.x_train, b.x_train)
+
+    def test_synthetic_stable_across_processes(self, tmp_path):
+        """The synthetic seed must not depend on Python's salted str hash —
+        resume across interpreter restarts needs identical data."""
+        import subprocess
+        import sys
+        code = ("import sys; sys.path.insert(0, '/root/repo'); "
+                "from iwae_replication_project_tpu.data import load_dataset; "
+                f"ds = load_dataset('mnist', data_dir={str(tmp_path)!r}); "
+                "print(float(ds.x_train.sum()))")
+        outs = set()
+        for _ in range(2):
+            r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                               text=True, env={"PYTHONHASHSEED": "random",
+                                               "PATH": "/usr/bin:/bin",
+                                               "JAX_PLATFORMS": "cpu"})
+            assert r.returncode == 0, r.stderr
+            outs.add(r.stdout.strip().splitlines()[-1])
+        assert len(outs) == 1, outs
+
+    def test_fashion_mnist_does_not_steal_root_mnist_files(self, tmp_path):
+        """Root-level idx files belong to plain MNIST; fashion_mnist must not
+        silently load them (same filenames, different dataset)."""
+        import gzip
+        import struct
+        img = np.zeros((3, 28, 28), np.uint8)
+        for split, n in (("train-images-idx3-ubyte.gz", 3),
+                         ("t10k-images-idx3-ubyte.gz", 3)):
+            with gzip.open(tmp_path / split, "wb") as f:
+                f.write(struct.pack(">IIII", 2051, n, 28, 28) + img.tobytes())
+        assert load_dataset("mnist", data_dir=str(tmp_path),
+                            allow_synthetic=False).x_train.shape == (3, 784)
+        with pytest.raises(FileNotFoundError):
+            load_dataset("fashion_mnist", data_dir=str(tmp_path),
+                         allow_synthetic=False)
+
+
+class TestBias:
+    def test_formula(self):
+        """bias = logit of clipped mean (flexible_IWAE.py:174)."""
+        means = np.array([0.0, 0.5, 1.0, 0.25])
+        bias = output_bias_from_pixel_means(means)
+        clipped = np.clip(means, 0.001, 0.999)
+        np.testing.assert_allclose(bias, np.log(clipped / (1 - clipped)), rtol=1e-5)
+        # sigmoid(bias) recovers the clipped means
+        np.testing.assert_allclose(1 / (1 + np.exp(-bias)), clipped, rtol=1e-4)
+
+
+class TestPipeline:
+    def test_batch_shapes_and_drop_remainder(self):
+        x = np.random.RandomState(0).rand(105, 784).astype(np.float32)
+        batches = list(epoch_batches(x, 10, epoch=0))
+        assert len(batches) == 10
+        assert all(b.shape == (10, 784) for b in batches)
+
+    def test_shuffle_covers_all_and_differs_by_epoch(self):
+        x = np.arange(40, dtype=np.float32).reshape(40, 1)
+        b0 = np.concatenate(list(epoch_batches(x, 10, epoch=0)))
+        b1 = np.concatenate(list(epoch_batches(x, 10, epoch=1)))
+        assert set(b0.ravel()) == set(range(40))
+        assert not np.array_equal(b0, b1)
+
+    def test_deterministic_given_seed_epoch(self):
+        x = np.random.RandomState(0).rand(40, 4).astype(np.float32)
+        a = list(epoch_batches(x, 10, epoch=3, seed=7))
+        b = list(epoch_batches(x, 10, epoch=3, seed=7))
+        for u, v in zip(a, b):
+            np.testing.assert_array_equal(u, v)
+
+    def test_stochastic_binarization(self):
+        x = np.full((20, 784), 0.5, np.float32)
+        batches = list(epoch_batches(x, 10, epoch=0,
+                                     binarization=Binarization.STOCHASTIC))
+        vals = np.concatenate(batches)
+        assert set(np.unique(vals)) <= {0.0, 1.0}
+        assert 0.3 < vals.mean() < 0.7
+        # fresh draws each epoch
+        again = np.concatenate(list(epoch_batches(x, 10, epoch=1,
+                                                  binarization=Binarization.STOCHASTIC)))
+        assert not np.array_equal(vals, again)

@@ -1,0 +1,152 @@
+"""Evaluation-suite tests: streaming NLL vs materialized, metric identities,
+active units on a model with deliberately dead latents."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu.evaluation import (
+    active_units,
+    batch_metrics,
+    nll_without_inactive_units,
+    posterior_mean_activity,
+    pca_eigenvalues,
+    reconstruction_loss,
+    streaming_log_px,
+    training_statistics,
+)
+from iwae_replication_project_tpu.models import ModelConfig, init_params, log_weights
+from iwae_replication_project_tpu.ops.logsumexp import logmeanexp
+
+CFG = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                  n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12)
+CFG2 = ModelConfig(n_hidden_enc=(16, 8), n_latent_enc=(6, 3),
+                   n_hidden_dec=(8, 16), n_latent_dec=(6, 12), x_dim=12)
+
+
+@pytest.fixture
+def setup(rng):
+    params = init_params(rng, CFG)
+    x = (jax.random.uniform(jax.random.PRNGKey(1), (6, 12)) > 0.5).astype(jnp.float32)
+    return params, x
+
+
+class TestStreamingNLL:
+    def test_matches_one_shot_same_keys(self, setup):
+        """Chunked online logsumexp == materialized logmeanexp when the chunks
+        see the same draws."""
+        params, x = setup
+        key = jax.random.PRNGKey(3)
+        k, chunk = 40, 8
+        got = streaming_log_px(params, CFG, key, x, k=k, chunk=chunk)
+        # rebuild the same per-chunk weights and reduce in one shot
+        lws = [log_weights(params, CFG, jax.random.fold_in(key, i), x, chunk)
+               for i in range(k // chunk)]
+        want = logmeanexp(jnp.concatenate(lws, axis=0), axis=0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+    def test_bad_chunk_raises(self, setup):
+        params, x = setup
+        with pytest.raises(ValueError):
+            streaming_log_px(params, CFG, jax.random.PRNGKey(0), x, k=41, chunk=8)
+
+
+class TestBatchMetrics:
+    def test_kl_identity(self, setup):
+        """D_kl(q||p(h)) metric == recon term - VAE bound by construction."""
+        params, x = setup
+        m = batch_metrics(params, CFG, jax.random.PRNGKey(0), x, k=16)
+        np.testing.assert_allclose(
+            float(m["D_kl(q(h|x),p(h))"]),
+            float(m["E_q(h|x)[log(p(x|h))]"] - m["VAE"]), rtol=1e-5)
+
+    def test_iwae_geq_vae(self, setup):
+        params, x = setup
+        m = batch_metrics(params, CFG, jax.random.PRNGKey(0), x, k=16)
+        assert float(m["IWAE"]) >= float(m["VAE"]) - 1e-5
+
+    def test_reconstruction_loss_positive(self, setup):
+        params, x = setup
+        r = reconstruction_loss(params, CFG, jax.random.PRNGKey(0), x)
+        assert float(r) > 0
+
+
+class TestActiveUnits:
+    def test_activity_shapes(self, rng):
+        params = init_params(rng, CFG2)
+        x = (jax.random.uniform(jax.random.PRNGKey(1), (20, 12)) > 0.5).astype(jnp.float32)
+        variances, eigvals = posterior_mean_activity(params, CFG2,
+                                                     jax.random.PRNGKey(2), x,
+                                                     n_samples=20, chunk=10)
+        assert len(variances) == 2
+        assert variances[0].shape == (6,) and variances[1].shape == (3,)
+        assert eigvals[0].shape == (6,) and eigvals[1].shape == (3,)
+
+    def test_pca_eigenvalues_match_numpy(self):
+        data = np.random.RandomState(0).randn(50, 5).astype(np.float32)
+        got = np.sort(np.asarray(pca_eigenvalues(jnp.asarray(data))))
+        centered = data - data.mean(0)
+        want = np.sort(np.linalg.eigvalsh(centered.T @ centered / 50))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_dead_unit_detected(self, rng):
+        """A latent coordinate whose encoder weights are zeroed must read as
+        inactive (variance of its posterior mean ~ 0)."""
+        params = init_params(rng, CFG)
+        # kill latent 0: zero its mu-head column -> posterior mean constant 0
+        mu = params["enc"][0]["mu"]
+        mu["w"] = mu["w"].at[:, 0].set(0.0)
+        mu["b"] = mu["b"].at[0].set(0.0)
+        x = (jax.random.uniform(jax.random.PRNGKey(1), (30, 12)) > 0.5).astype(jnp.float32)
+        variances, eigvals = posterior_mean_activity(params, CFG,
+                                                     jax.random.PRNGKey(2), x,
+                                                     n_samples=200, chunk=20)
+        masks, n_active, _ = active_units(variances, eigvals, threshold=0.01)
+        assert masks[0][0] == 0.0
+        assert n_active[0] <= 3
+
+    def test_pruned_nll_close_when_pruning_dead_unit(self, rng):
+        """Zeroing an already-dead unit should barely move the NLL."""
+        params = init_params(rng, CFG)
+        mu = params["enc"][0]["mu"]
+        mu["w"] = mu["w"].at[:, 0].set(0.0)
+        mu["b"] = mu["b"].at[0].set(0.0)
+        lstd = params["enc"][0]["lstd"]
+        lstd["w"] = lstd["w"].at[:, 0].set(0.0)
+        lstd["b"] = lstd["b"].at[0].set(-6.0)  # tiny posterior std for unit 0
+        x = (jax.random.uniform(jax.random.PRNGKey(1), (6, 12)) > 0.5).astype(jnp.float32)
+        masks = (jnp.array([0.0, 1.0, 1.0, 1.0]),)
+        pruned = float(nll_without_inactive_units(params, CFG, jax.random.PRNGKey(2),
+                                                  x, masks, k=200, chunk=50))
+        from iwae_replication_project_tpu.evaluation.metrics import streaming_nll
+        full = float(streaming_nll(params, CFG, jax.random.PRNGKey(2), x,
+                                   k=200, chunk=50))
+        assert abs(pruned - full) < 2.0
+
+
+class TestTrainingStatistics:
+    def test_full_driver_schema(self, rng):
+        params = init_params(rng, CFG)
+        x_test = (jax.random.uniform(jax.random.PRNGKey(1), (20, 12)) > 0.5).astype(jnp.float32)
+        res, res2 = training_statistics(params, CFG, jax.random.PRNGKey(2),
+                                        x_test, k=8, batch_size=10, nll_k=40,
+                                        nll_chunk=20, activity_samples=20)
+        for key in ("VAE", "IWAE", "NLL", "E_q(h|x)[log(p(x|h))]",
+                    "D_kl(q(h|x),p(h))", "D_kl(q(h|x),p(h|x))",
+                    "reconstruction_loss", "LL_pruned"):
+            assert key in res and np.isfinite(res[key]), key
+        assert len(res2["number_of_active_units"]) == 1
+        assert res2["active_units"][0].shape == (4,)
+        assert res["NLL"] > 0
+
+    def test_non_dividing_batch_size_adapts(self, rng):
+        """A batch size that doesn't divide the test set falls back to the
+        largest divisor instead of crashing (found driving the CLI on a
+        256-image synthetic test set with the default eval batch of 100)."""
+        params = init_params(rng, CFG)
+        x = (jax.random.uniform(jax.random.PRNGKey(1), (10, 12)) > 0.5).astype(jnp.float32)
+        res, _ = training_statistics(params, CFG, jax.random.PRNGKey(0), x, k=4,
+                                     batch_size=3, nll_k=8, nll_chunk=4,
+                                     activity_samples=4, include_pruned_nll=False)
+        assert np.isfinite(res["NLL"])

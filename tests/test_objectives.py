@@ -1,0 +1,194 @@
+"""Estimator tests: the reference's mathematical identities as free oracles
+(SURVEY.md §4): IWAE_1 == VAE_1, MIWAE edge cases, power_1 == IWAE, CIWAE
+endpoints, analytic-vs-MC ELBO, monotonicity in k, and modified-gradient
+estimator correctness (DReG/STL/PIWAE)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu.models import ModelConfig, init_params, log_weights_and_aux
+from iwae_replication_project_tpu.objectives import (
+    ObjectiveSpec,
+    alpha_bound,
+    bound_from_log_weights,
+    ciwae_bound,
+    iwae_bound,
+    median_bound,
+    miwae_bound,
+    objective_bound,
+    objective_value_and_grad,
+    power_bound,
+    vae_bound,
+)
+
+CFG = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                  n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12)
+CFG2 = ModelConfig(n_hidden_enc=(16, 8), n_latent_enc=(6, 3),
+                   n_hidden_dec=(8, 16), n_latent_dec=(6, 12), x_dim=12)
+
+
+@pytest.fixture
+def log_w():
+    return jnp.asarray(np.random.RandomState(0).randn(12, 5).astype(np.float32) * 3)
+
+
+@pytest.fixture
+def model_setup(rng):
+    params = init_params(rng, CFG)
+    x = (jax.random.uniform(jax.random.PRNGKey(1), (5, 12)) > 0.5).astype(jnp.float32)
+    return params, x
+
+
+class TestReducerIdentities:
+    def test_iwae_k1_equals_vae(self):
+        lw = jnp.asarray(np.random.RandomState(1).randn(1, 7).astype(np.float32))
+        np.testing.assert_allclose(iwae_bound(lw), vae_bound(lw), rtol=1e-6)
+
+    def test_miwae_edges(self, log_w):
+        k = log_w.shape[0]
+        np.testing.assert_allclose(miwae_bound(log_w, k2=1), iwae_bound(log_w), rtol=1e-6)
+        np.testing.assert_allclose(miwae_bound(log_w, k2=k), vae_bound(log_w), rtol=1e-5)
+
+    def test_power_p1_equals_iwae(self, log_w):
+        np.testing.assert_allclose(power_bound(log_w, 1.0), iwae_bound(log_w), rtol=1e-6)
+
+    def test_ciwae_endpoints(self, log_w):
+        np.testing.assert_allclose(ciwae_bound(log_w, 1.0), vae_bound(log_w), rtol=1e-6)
+        np.testing.assert_allclose(ciwae_bound(log_w, 0.0), iwae_bound(log_w), rtol=1e-6)
+
+    def test_alpha1_equals_vae(self, log_w):
+        recon = jnp.abs(log_w)
+        np.testing.assert_allclose(alpha_bound(log_w, recon, 1.0), vae_bound(log_w), rtol=1e-6)
+
+    def test_median_midpoint_even_k(self):
+        lw = jnp.asarray(np.array([[1.0], [2.0], [10.0], [3.0]], np.float32))
+        # midpoint of {2, 3} = 2.5 — matches tfp percentile 'midpoint' semantics
+        np.testing.assert_allclose(median_bound(lw), 2.5, rtol=1e-6)
+
+    def test_jensen_ordering(self, log_w):
+        # VAE <= MIWAE <= IWAE <= logsumexp bound orderings from Jensen
+        assert float(vae_bound(log_w)) <= float(miwae_bound(log_w, k2=4)) + 1e-5
+        assert float(miwae_bound(log_w, k2=4)) <= float(iwae_bound(log_w)) + 1e-5
+
+    def test_power_monotone_in_p(self, log_w):
+        # power-mean inequality: higher p => higher bound value
+        b = [float(power_bound(log_w, p)) for p in (0.5, 1.0, 2.0, 5.0)]
+        assert all(b[i] <= b[i + 1] + 1e-5 for i in range(3))
+
+
+class TestModelBounds:
+    def test_v1_matches_mc_elbo(self, model_setup):
+        """Analytic-KL ELBO vs MC ELBO (the reference's built-in oracle,
+        flexible_IWAE.py:425)."""
+        params, x = model_setup
+        k = 4000
+        key = jax.random.PRNGKey(3)
+        mc = objective_bound(ObjectiveSpec("VAE", k=k), params, CFG, key, x)
+        v1 = objective_bound(ObjectiveSpec("VAE_V1", k=k), params, CFG, key, x)
+        np.testing.assert_allclose(float(mc), float(v1), atol=0.05)
+
+    def test_iwae_monotone_in_k(self, model_setup):
+        """E[L_{k}] nondecreasing in k (Burda Thm 1; PDF p.5 Eq. 3)."""
+        params, x = model_setup
+        bounds = []
+        for k in (1, 5, 25, 125):
+            vals = [float(objective_bound(ObjectiveSpec("IWAE", k=k), params, CFG,
+                                          jax.random.PRNGKey(100 + r), x))
+                    for r in range(20)]
+            bounds.append(np.mean(vals))
+        assert all(bounds[i] <= bounds[i + 1] + 0.05 for i in range(len(bounds) - 1))
+
+    def test_dispatch_all_names(self, model_setup):
+        params, x = model_setup
+        key = jax.random.PRNGKey(5)
+        for name in ("VAE", "IWAE", "VAE_V1", "L_alpha", "L_power_p", "L_median",
+                     "CIWAE", "MIWAE", "PIWAE", "DReG", "STL"):
+            spec = ObjectiveSpec(name, k=8, k2=2, p=2.0, alpha=0.5, beta=0.3)
+            val = objective_bound(spec, params, CFG, key, x)
+            assert np.isfinite(float(val)), name
+
+    def test_aux_required_errors(self, log_w):
+        with pytest.raises(ValueError):
+            bound_from_log_weights(ObjectiveSpec("L_alpha", k=12), log_w, None)
+        with pytest.raises(ValueError):
+            bound_from_log_weights(ObjectiveSpec("VAE_V1", k=12), log_w, None)
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            ObjectiveSpec("nope")
+        with pytest.raises(ValueError):
+            ObjectiveSpec("MIWAE", k=10, k2=3)
+
+
+class TestGradientEstimators:
+    def test_standard_grad_matches_manual(self, model_setup):
+        params, x = model_setup
+        key = jax.random.PRNGKey(5)
+        spec = ObjectiveSpec("IWAE", k=6)
+        val, grads = objective_value_and_grad(spec, params, CFG, key, x)
+        val2, grads2 = jax.value_and_grad(
+            lambda p: objective_bound(spec, p, CFG, key, x))(params)
+        np.testing.assert_allclose(float(val), float(val2), rtol=1e-6)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
+                     grads, grads2)
+
+    def test_stl_decoder_grad_matches_iwae(self, model_setup):
+        """Score-stopping must not change decoder gradients."""
+        params, x = model_setup
+        key = jax.random.PRNGKey(5)
+        _, g_stl = objective_value_and_grad(ObjectiveSpec("STL", k=6), params, CFG, key, x)
+        _, g_iwae = objective_value_and_grad(ObjectiveSpec("IWAE", k=6), params, CFG, key, x)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+                     {"dec": g_stl["dec"], "out": g_stl["out"]},
+                     {"dec": g_iwae["dec"], "out": g_iwae["out"]})
+
+    def test_dreg_decoder_grad_matches_iwae(self, model_setup):
+        params, x = model_setup
+        key = jax.random.PRNGKey(5)
+        _, g = objective_value_and_grad(ObjectiveSpec("DReG", k=6), params, CFG, key, x)
+        _, g_iwae = objective_value_and_grad(ObjectiveSpec("IWAE", k=6), params, CFG, key, x)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+                     {"dec": g["dec"], "out": g["out"]},
+                     {"dec": g_iwae["dec"], "out": g_iwae["out"]})
+
+    def test_dreg_encoder_grad_differs(self, model_setup):
+        params, x = model_setup
+        key = jax.random.PRNGKey(5)
+        _, g = objective_value_and_grad(ObjectiveSpec("DReG", k=6), params, CFG, key, x)
+        _, g_iwae = objective_value_and_grad(ObjectiveSpec("IWAE", k=6), params, CFG, key, x)
+        diffs = jax.tree.leaves(jax.tree.map(lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                                             g["enc"], g_iwae["enc"]))
+        assert max(diffs) > 1e-6
+
+    def test_piwae_grads_match_parts(self, model_setup):
+        """PIWAE encoder grad == MIWAE grad's encoder part; decoder == IWAE's."""
+        params, x = model_setup
+        key = jax.random.PRNGKey(5)
+        spec = ObjectiveSpec("PIWAE", k=6, k2=3)
+        _, g = objective_value_and_grad(spec, params, CFG, key, x)
+        _, g_miwae = jax.value_and_grad(
+            lambda p: objective_bound(ObjectiveSpec("MIWAE", k=6, k2=3), p, CFG, key, x))(params)
+        _, g_iwae = jax.value_and_grad(
+            lambda p: objective_bound(ObjectiveSpec("IWAE", k=6), p, CFG, key, x))(params)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+                     g["enc"], g_miwae["enc"])
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+                     {"dec": g["dec"], "out": g["out"]},
+                     {"dec": g_iwae["dec"], "out": g_iwae["out"]})
+
+    def test_stl_unbiased_same_bound(self, model_setup):
+        params, x = model_setup
+        key = jax.random.PRNGKey(5)
+        v_stl, _ = objective_value_and_grad(ObjectiveSpec("STL", k=6), params, CFG, key, x)
+        v_iwae, _ = objective_value_and_grad(ObjectiveSpec("IWAE", k=6), params, CFG, key, x)
+        np.testing.assert_allclose(float(v_stl), float(v_iwae), rtol=1e-6)
+
+    def test_multilayer_gradients_finite(self, rng):
+        params = init_params(rng, CFG2)
+        x = (jax.random.uniform(jax.random.PRNGKey(1), (4, 12)) > 0.5).astype(jnp.float32)
+        for name in ("IWAE", "DReG", "STL", "PIWAE", "MIWAE"):
+            _, g = objective_value_and_grad(ObjectiveSpec(name, k=4, k2=2), params, CFG2,
+                                            jax.random.PRNGKey(2), x)
+            assert all(np.all(np.isfinite(np.asarray(l))) for l in jax.tree.leaves(g)), name

@@ -1,0 +1,125 @@
+"""Unit tests for the numerics layer: closed-form log-probs and logsumexp.
+
+Oracles: scipy-free closed forms computed in numpy float64, plus extreme-value
+stability goldens (SURVEY.md §4 test plan).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu.ops import (
+    bernoulli_log_prob,
+    clamp_probs,
+    logmeanexp,
+    logsumexp,
+    normal_kl_standard,
+    normal_log_prob,
+    normal_sample,
+)
+from iwae_replication_project_tpu.ops.logsumexp import (
+    online_logsumexp_finalize,
+    online_logsumexp_init,
+    online_logsumexp_merge,
+    online_logsumexp_update,
+    streaming_logmeanexp,
+)
+
+
+def np_normal_logpdf(x, mu, std):
+    return -0.5 * ((x - mu) / std) ** 2 - np.log(std) - 0.5 * math.log(2 * math.pi)
+
+
+class TestNormal:
+    def test_log_prob_matches_closed_form(self, rng):
+        x = np.random.RandomState(0).randn(5, 7).astype(np.float32)
+        mu = np.float32(0.3)
+        std = np.float32(1.7)
+        got = normal_log_prob(jnp.asarray(x), mu, std)
+        np.testing.assert_allclose(got, np_normal_logpdf(x, mu, std), rtol=1e-5)
+
+    def test_sample_moments_and_shape(self, rng):
+        mu = jnp.array([1.0, -2.0])
+        std = jnp.array([0.5, 2.0])
+        s = normal_sample(rng, mu, std, sample_shape=(20000,))
+        assert s.shape == (20000, 2)
+        np.testing.assert_allclose(jnp.mean(s, axis=0), mu, atol=0.05)
+        np.testing.assert_allclose(jnp.std(s, axis=0), std, atol=0.05)
+
+    def test_sample_is_reparameterized(self, rng):
+        # gradient of E[s] wrt mu must be 1 exactly (pathwise).
+        g = jax.grad(lambda m: jnp.mean(normal_sample(rng, m, 1.0, (100,))))(0.0)
+        np.testing.assert_allclose(g, 1.0, rtol=1e-6)
+
+    def test_kl_standard_matches_mc(self, rng):
+        mu, std = jnp.float32(0.7), jnp.float32(1.3)
+        analytic = normal_kl_standard(mu, std)
+        s = normal_sample(rng, mu, std, sample_shape=(200000,))
+        mc = jnp.mean(normal_log_prob(s, mu, std) - (-0.5 * s * s - 0.5 * math.log(2 * math.pi)))
+        np.testing.assert_allclose(analytic, mc, atol=0.02)
+
+
+class TestBernoulli:
+    def test_log_prob_binary_targets(self):
+        p = jnp.array([0.2, 0.8])
+        np.testing.assert_allclose(bernoulli_log_prob(jnp.array([1.0, 0.0]), p),
+                                   np.log([0.2, 0.2]), rtol=1e-6)
+
+    def test_clamp_keeps_finite_at_extremes(self):
+        p = clamp_probs(jnp.array([0.0, 1.0]))
+        lp = bernoulli_log_prob(jnp.array([1.0, 0.0]), p)
+        assert np.all(np.isfinite(np.asarray(lp)))
+
+
+class TestLogsumexp:
+    def test_matches_naive_small(self):
+        x = jnp.asarray(np.random.RandomState(1).randn(50, 4).astype(np.float32))
+        np.testing.assert_allclose(logsumexp(x, 0), np.log(np.sum(np.exp(np.asarray(x, np.float64)), 0)),
+                                   rtol=1e-5)
+
+    def test_stable_at_extreme_values(self):
+        x = jnp.array([[1000.0, -1000.0], [999.0, -999.0]])
+        out = logmeanexp(x, axis=0)
+        expected0 = 1000.0 + math.log((1 + math.exp(-1.0)) / 2)
+        expected1 = -999.0 + math.log((1 + math.exp(-1.0)) / 2)
+        np.testing.assert_allclose(out, [expected0, expected1], rtol=1e-6)
+
+    def test_all_neg_inf_column(self):
+        x = jnp.full((4, 2), -jnp.inf)
+        assert np.all(np.asarray(logsumexp(x, 0)) == -np.inf)
+
+    def test_gradient_is_softmax(self):
+        x = jnp.asarray(np.random.RandomState(2).randn(6).astype(np.float32))
+        g = jax.grad(lambda v: logsumexp(v, 0))(x)
+        np.testing.assert_allclose(g, jax.nn.softmax(x), rtol=1e-5)
+
+
+class TestOnlineLogsumexp:
+    def test_chunked_equals_full(self):
+        x = np.random.RandomState(3).randn(64, 5).astype(np.float32) * 10
+        state = online_logsumexp_init((5,))
+        for i in range(0, 64, 16):
+            state = online_logsumexp_update(state, jnp.asarray(x[i:i + 16]), axis=0)
+        got = online_logsumexp_finalize(state, mean=True)
+        np.testing.assert_allclose(got, logmeanexp(jnp.asarray(x), 0), rtol=1e-5)
+
+    def test_merge_associative(self):
+        x = np.random.RandomState(4).randn(32, 3).astype(np.float32)
+        a = online_logsumexp_update(online_logsumexp_init((3,)), jnp.asarray(x[:16]))
+        b = online_logsumexp_update(online_logsumexp_init((3,)), jnp.asarray(x[16:]))
+        merged = online_logsumexp_finalize(online_logsumexp_merge(a, b), mean=True)
+        np.testing.assert_allclose(merged, logmeanexp(jnp.asarray(x), 0), rtol=1e-5)
+
+    def test_streaming_fn(self):
+        x = np.random.RandomState(5).randn(40, 6).astype(np.float32)
+        xj = jnp.asarray(x)
+        got = streaming_logmeanexp(lambda i: jax.lax.dynamic_slice_in_dim(xj, i * 8, 8, 0),
+                                   k=40, chunk=8, shape=(6,))
+        np.testing.assert_allclose(got, logmeanexp(xj, 0), rtol=1e-5)
+
+    def test_streaming_rejects_bad_chunk(self):
+        with pytest.raises(ValueError):
+            streaming_logmeanexp(lambda i: jnp.zeros((7, 2)), k=40, chunk=7, shape=(2,))

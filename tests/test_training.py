@@ -1,0 +1,133 @@
+"""Training-layer tests: schedule values, state creation, descent, LR
+injection, checkpoint round-trip."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu.models import ModelConfig
+from iwae_replication_project_tpu.objectives import ObjectiveSpec
+from iwae_replication_project_tpu.training import (
+    burda_stage_lr,
+    burda_stages,
+    create_train_state,
+    make_adam,
+    make_train_step,
+)
+from iwae_replication_project_tpu.training.train_step import set_learning_rate
+from iwae_replication_project_tpu.utils.checkpoint import (
+    latest_step,
+    restore_latest,
+    save_checkpoint,
+)
+
+CFG = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                  n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12)
+
+
+def make_batch(b=16, d=12):
+    return (jax.random.uniform(jax.random.PRNGKey(42), (b, d)) > 0.5).astype(jnp.float32)
+
+
+class TestSchedule:
+    def test_burda_lr_endpoints(self):
+        """Stage 1 -> 1e-3, stage 8 -> 1e-4 (experiment_example.py:76)."""
+        np.testing.assert_allclose(burda_stage_lr(1), 1e-3, rtol=1e-9)
+        np.testing.assert_allclose(burda_stage_lr(8), 1e-4, rtol=1e-9)
+
+    def test_total_passes_3280(self):
+        """Sum 3^(i-1), i=1..8 == 3280 (PDF §3.4)."""
+        assert sum(p for _, _, p in burda_stages(8)) == 3280
+
+    def test_monotone_decreasing(self):
+        lrs = [lr for _, lr, _ in burda_stages(8)]
+        assert all(a >= b for a, b in zip(lrs, lrs[1:]))
+
+
+class TestTrainStep:
+    def test_state_shapes_and_bias(self, rng):
+        bias = np.linspace(-1, 1, 12).astype(np.float32)
+        state = create_train_state(rng, CFG, output_bias=bias)
+        np.testing.assert_allclose(np.asarray(state.params["out"]["out"]["b"]),
+                                   bias, rtol=1e-6)
+
+    def test_loss_decreases(self, rng):
+        state = create_train_state(rng, CFG)
+        step = make_train_step(ObjectiveSpec("IWAE", k=8), CFG, donate=False)
+        batch = make_batch()
+        losses = []
+        for _ in range(30):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        assert np.mean(losses[-5:]) < np.mean(losses[:5])
+        assert int(state.step) == 30
+
+    def test_lr_injection_preserves_moments(self, rng):
+        state = create_train_state(rng, CFG, lr=1e-3)
+        step = make_train_step(ObjectiveSpec("VAE", k=4), CFG, donate=False)
+        state, _ = step(state, make_batch())
+        # after one step, moments are nonzero
+        mu_leaves = jax.tree.leaves(state.opt_state.inner_state[0].mu)
+        assert any(float(jnp.max(jnp.abs(l))) > 0 for l in mu_leaves)
+        state2 = set_learning_rate(state, 5e-4)
+        np.testing.assert_allclose(
+            float(state2.opt_state.hyperparams["learning_rate"]), 5e-4)
+        # the old state must be untouched (no aliased in-place mutation)
+        np.testing.assert_allclose(
+            float(state.opt_state.hyperparams["learning_rate"]), 1e-3)
+        # moments unchanged
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                                np.asarray(b)),
+                     state.opt_state.inner_state[0].mu,
+                     state2.opt_state.inner_state[0].mu)
+
+    def test_adam_eps_default(self):
+        """Reference parity: eps=1e-4 (experiment_example.py:39)."""
+        opt = make_adam()
+        state = opt.init({"w": jnp.zeros(3)})
+        # inject_hyperparams stores only injected hyperparams; eps is traced
+        # into the update fn — verify numerically: with g=0 update must be 0,
+        # with tiny g the eps dominates the denominator.
+        g = {"w": jnp.full(3, 1e-8)}
+        updates, _ = opt.update(g, state, {"w": jnp.zeros(3)})
+        # adam first step: m_hat = g, v_hat = g^2 ; update = lr*m_hat/(sqrt(v_hat)+eps)
+        expected = -1e-3 * 1e-8 / (1e-8 + 1e-4)
+        np.testing.assert_allclose(np.asarray(updates["w"]),
+                                   np.full(3, expected), rtol=1e-4)
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, rng, tmp_path):
+        d = os.path.join(str(tmp_path), "ckpt")
+        state = create_train_state(rng, CFG)
+        step = make_train_step(ObjectiveSpec("IWAE", k=4), CFG, donate=False)
+        state, _ = step(state, make_batch())
+        save_checkpoint(d, 1, state, stage=3, config_json='{"a": 1}')
+        assert latest_step(d) == 1
+
+        template = create_train_state(jax.random.PRNGKey(99), CFG)
+        restored = restore_latest(d, template)
+        assert restored is not None
+        rstep, rstate, rstage = restored
+        assert rstep == 1 and rstage == 3
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                                np.asarray(b)),
+                     state.params, rstate.params)
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                                np.asarray(b)),
+                     state.opt_state.inner_state[0].mu,
+                     rstate.opt_state.inner_state[0].mu)
+
+    def test_restore_missing_returns_none(self, rng, tmp_path):
+        template = create_train_state(rng, CFG)
+        assert restore_latest(os.path.join(str(tmp_path), "nope"), template) is None
+
+    def test_retention(self, rng, tmp_path):
+        d = os.path.join(str(tmp_path), "ckpt")
+        state = create_train_state(rng, CFG)
+        for s in range(5):
+            save_checkpoint(d, s, state, stage=s, keep=2)
+        assert latest_step(d) == 4
