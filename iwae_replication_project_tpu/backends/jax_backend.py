@@ -90,12 +90,30 @@ class JaxFlexibleModel(FlexibleModel):
     def fit(self, x_train, epochs: int = 1, batch_size: int = 100,
             binarization: str = "none", shuffle: bool = True,
             verbose: bool = False) -> Dict[str, list]:
-        """Epoch loop over host batches (replaces keras .fit,
-        experiment_example.py:82)."""
-        from iwae_replication_project_tpu.data import epoch_batches
+        """Train for `epochs` passes (replaces keras .fit, experiment_example.py:82).
+
+        Single-device execution runs each whole epoch as ONE compiled scan
+        (training/epoch.py): data stays in HBM, shuffle + stochastic
+        binarization + all optimizer steps happen on device. Mesh execution
+        falls back to per-batch sharded steps.
+        """
         self._require_compiled()
         x_train = self._flatten(np.asarray(x_train))
         history = {"loss": []}
+        if self.mesh is None:
+            epoch_fn = self._get_epoch_fn(x_train.shape[0], batch_size,
+                                          binarization, shuffle)
+            x_dev = jnp.asarray(x_train)
+            n_batches = x_train.shape[0] // batch_size
+            for e in range(epochs):
+                self.state, losses = epoch_fn(self.state, x_dev)
+                self.epoch += n_batches
+                history["loss"].append(float(jnp.mean(losses)))
+                if verbose:
+                    print(f"epoch {e + 1}/{epochs}: loss={history['loss'][-1]:.4f}")
+            return history
+
+        from iwae_replication_project_tpu.data import epoch_batches
         for e in range(epochs):
             losses = []
             for batch in epoch_batches(x_train, batch_size, epoch=self.epoch + e,
@@ -108,6 +126,21 @@ class JaxFlexibleModel(FlexibleModel):
             if verbose:
                 print(f"epoch {e + 1}/{epochs}: loss={history['loss'][-1]:.4f}")
         return history
+
+    def _get_epoch_fn(self, n_train: int, batch_size: int, binarization: str,
+                      shuffle: bool):
+        from iwae_replication_project_tpu.training.epoch import make_epoch_fn
+        # the objective spec and optimizer identity are part of the key: a
+        # re-compile() (new optimizer / changed loss attributes) must rebuild
+        sig = (n_train, batch_size, binarization, shuffle,
+               self.objective_spec(), id(self._optimizer))
+        if getattr(self, "_epoch_sig", None) != sig:
+            self._epoch_fn = make_epoch_fn(
+                self.objective_spec(), self.cfg, n_train, batch_size,
+                stochastic_binarization=binarization == "stochastic",
+                optimizer=self._optimizer, shuffle=shuffle, donate=False)
+            self._epoch_sig = sig
+        return self._epoch_fn
 
     # ------------------------------------------------------------------
     # objectives surface (reference get_L_* family)

@@ -37,6 +37,12 @@ from iwae_replication_project_tpu.ops.logsumexp import (
 )
 
 
+def largest_divisor_leq(n: int, cap: int) -> int:
+    """Largest divisor of `n` not exceeding `cap` — used to adapt requested
+    chunk/batch sizes to whatever the data actually divides into."""
+    return max(d for d in range(1, min(cap, n) + 1) if n % d == 0)
+
+
 @partial(jax.jit, static_argnames=("cfg", "k"))
 def batch_metrics(params, cfg: model.ModelConfig, key: jax.Array, x: jax.Array,
                   k: int) -> Dict[str, jax.Array]:
@@ -106,10 +112,10 @@ def training_statistics(params, cfg: model.ModelConfig, key: jax.Array,
     import iwae_replication_project_tpu.evaluation.activity as au
 
     n = x_test.shape[0]
-    if n % batch_size != 0:
-        # largest divisor of the test-set size not exceeding the request, so the
-        # driver works for any test-set length (the reference hard-assumes 10 | n)
-        batch_size = max(d for d in range(1, min(batch_size, n) + 1) if n % d == 0)
+    # adapt the requested sizes so the driver works for any test-set length /
+    # NLL sample count (the reference hard-assumes 10 | n)
+    batch_size = largest_divisor_leq(n, batch_size)
+    nll_chunk = largest_divisor_leq(nll_k, nll_chunk)
     n_batches = n // batch_size
     batches = x_test.reshape(n_batches, batch_size, -1)
 

@@ -21,11 +21,11 @@ import jax
 import numpy as np
 
 from iwae_replication_project_tpu.data import load_dataset, epoch_batches
+from iwae_replication_project_tpu.evaluation.metrics import largest_divisor_leq
 from iwae_replication_project_tpu.evaluation import metrics as ev
 from iwae_replication_project_tpu.training import (
     burda_stages,
     create_train_state,
-    make_train_step,
     make_adam,
 )
 from iwae_replication_project_tpu.training.train_step import set_learning_rate
@@ -59,6 +59,7 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
                                output_bias=ds.output_bias, optimizer=opt)
 
     mesh = None
+    epoch_fn = None
     if cfg.mesh_dp is not None or cfg.mesh_sp > 1:
         from iwae_replication_project_tpu.parallel import make_mesh, make_parallel_train_step
         from iwae_replication_project_tpu.parallel.dp import replicate, shard_batch
@@ -68,8 +69,17 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         state = replicate(mesh, state)
         place = lambda b: shard_batch(mesh, b)  # noqa: E731
     else:
-        step_fn = make_train_step(spec, model_cfg, optimizer=opt, donate=False)
-        place = jax.numpy.asarray
+        # single device: whole-epoch scan (one dispatch per pass over the data)
+        from iwae_replication_project_tpu.training.epoch import make_epoch_fn
+        n_train = len(ds.x_train)
+        if max_batches_per_pass is not None:
+            n_train = min(n_train, max_batches_per_pass * cfg.batch_size)
+        epoch_fn = make_epoch_fn(
+            spec, model_cfg, n_train, cfg.batch_size,
+            stochastic_binarization=ds.binarization == "stochastic",
+            optimizer=opt, donate=False)
+        x_train_dev = jax.numpy.asarray(
+            ds.x_train[:n_train].reshape(n_train, -1))
 
     ckpt_dir = os.path.join(cfg.checkpoint_dir, cfg.run_name())
     start_stage = 1
@@ -91,12 +101,15 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         state = set_learning_rate(state, lr)
         print(f"stage {stage}: lr={lr:.2e}, {passes} passes")
         for p in range(passes):
-            for bi, batch in enumerate(epoch_batches(
-                    ds.x_train, cfg.batch_size, epoch=int(state.step),
-                    seed=cfg.seed, binarization=ds.binarization)):
-                if max_batches_per_pass is not None and bi >= max_batches_per_pass:
-                    break
-                state, metrics = step_fn(state, place(batch))
+            if epoch_fn is not None:
+                state, _ = epoch_fn(state, x_train_dev)
+            else:
+                for bi, batch in enumerate(epoch_batches(
+                        ds.x_train, cfg.batch_size, epoch=int(state.step),
+                        seed=cfg.seed, binarization=ds.binarization)):
+                    if max_batches_per_pass is not None and bi >= max_batches_per_pass:
+                        break
+                    state, metrics = step_fn(state, place(batch))
 
         res, res2 = ev.training_statistics(
             state.params, model_cfg, jax.random.fold_in(eval_key, stage),
@@ -156,7 +169,9 @@ def _run_experiment_torch(cfg: ExperimentConfig,
         res = {
             "VAE": float(mdl.get_L(x_test, cfg.eval_k)),
             "IWAE": float(mdl.get_L_k(x_test, cfg.eval_k)),
-            "NLL": float(mdl.get_NLL(x_test, k=cfg.nll_k, chunk=cfg.nll_chunk)),
+            "NLL": float(mdl.get_NLL(x_test, k=cfg.nll_k,
+                                     chunk=largest_divisor_leq(cfg.nll_k,
+                                                               cfg.nll_chunk))),
             "learning_rate": lr, "stage": stage,
         }
         print(res)

@@ -20,6 +20,7 @@ Shapes follow the reference's convention: ``h[i]`` has shape
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -52,6 +53,10 @@ class ModelConfig:
     likelihood: str = "clamp"
     # None | "bfloat16" — matmul operand dtype; accumulation stays float32.
     compute_dtype: Optional[str] = None
+    # Fuse the decoder output matmul + Bernoulli loglik + pixel reduction into
+    # one Pallas kernel so the [k, B, x_dim] logits tensor never hits HBM.
+    # Requires likelihood="logits". (ops/fused_likelihood.py)
+    fused_likelihood: bool = False
 
     def __post_init__(self):
         L = self.n_stochastic
@@ -62,6 +67,8 @@ class ModelConfig:
             raise ValueError(f"n_latent_dec[-1]={self.n_latent_dec[-1]} must equal x_dim={self.x_dim}")
         if self.likelihood not in ("clamp", "logits"):
             raise ValueError(f"unknown likelihood {self.likelihood!r}")
+        if self.fused_likelihood and self.likelihood != "logits":
+            raise ValueError("fused_likelihood requires likelihood='logits'")
 
     @property
     def n_stochastic(self) -> int:
@@ -165,6 +172,14 @@ def decode_probs(params: Params, cfg: ModelConfig, h1: jax.Array) -> jax.Array:
 def log_px_given_h(params: Params, cfg: ModelConfig, x: jax.Array,
                    h1: jax.Array) -> jax.Array:
     """``log p(x|h)`` summed over pixels -> ``[k, B]`` (flexible_IWAE.py:123-129)."""
+    if cfg.fused_likelihood:
+        from iwae_replication_project_tpu.ops.fused_likelihood import (
+            fused_bernoulli_ll)
+        out = params["out"]
+        y = jnp.tanh(mlp.dense_apply(out["l1"], h1, cfg.matmul_dtype))
+        y = jnp.tanh(mlp.dense_apply(out["l2"], y, cfg.matmul_dtype))
+        return fused_bernoulli_ll(y, out["out"]["w"], out["out"]["b"], x,
+                                  not _on_tpu())
     logits = decode_logits(params, cfg, h1)
     if cfg.likelihood == "clamp":
         probs = dist.clamp_probs(jax.nn.sigmoid(logits))
@@ -172,6 +187,11 @@ def log_px_given_h(params: Params, cfg: ModelConfig, x: jax.Array,
     else:
         lp = dist.bernoulli_log_prob_from_logits(x, logits)
     return jnp.sum(lp, axis=-1)
+
+
+@functools.lru_cache(maxsize=1)
+def _on_tpu() -> bool:
+    return any(d.platform == "tpu" for d in jax.devices())
 
 
 def log_prior(params: Params, cfg: ModelConfig, h: Tuple[jax.Array, ...]) -> jax.Array:

@@ -1,0 +1,68 @@
+"""Whole-epoch training as ONE compiled XLA call.
+
+At reference scale (2-layer MLPs, batch 100) a single train step is ~300 us of
+TPU work — per-step Python dispatch dominates wall-clock. The TPU-native
+answer: keep the training set resident in HBM, and run shuffle + (optional)
+stochastic binarization + every optimizer step of an epoch inside one
+`lax.scan`. The host issues one dispatch per epoch instead of N_batches.
+
+This also moves the data pipeline's randomness on-device: the permutation and
+the Bernoulli re-binarization draw from the same threaded PRNG key as the
+model noise, so an epoch is a pure function `(state, x_train, epoch_idx) ->
+(state, losses)` — reproducible, checkpointable, and shardable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from iwae_replication_project_tpu.models import iwae as model
+from iwae_replication_project_tpu.objectives import ObjectiveSpec, objective_value_and_grad
+from iwae_replication_project_tpu.training.train_step import TrainState, make_adam
+
+
+def make_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, n_train: int,
+                  batch_size: int, stochastic_binarization: bool = False,
+                  optimizer: optax.GradientTransformation | None = None,
+                  shuffle: bool = True, donate: bool = True
+                  ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, jax.Array]]:
+    """Build ``epoch(state, x_train) -> (state, per-batch losses)``, jitted.
+
+    `x_train` is the full ``[n_train, x_dim]`` set (placed on device once by
+    the caller); drop-remainder batching like the host pipeline.
+    """
+    opt = optimizer if optimizer is not None else make_adam()
+    n_batches = n_train // batch_size
+    if n_batches == 0:
+        raise ValueError(f"batch_size={batch_size} exceeds n_train={n_train}")
+
+    def epoch(state: TrainState, x_train: jax.Array):
+        key, k_perm, k_bin = jax.random.split(state.key, 3)
+        if shuffle:
+            perm = jax.random.permutation(k_perm, n_train)
+        else:
+            perm = jnp.arange(n_train)
+        idx = perm[: n_batches * batch_size].reshape(n_batches, batch_size)
+
+        def body(st, xs):
+            batch_idx, i = xs
+            batch = x_train[batch_idx]
+            if stochastic_binarization:
+                batch = jax.random.bernoulli(
+                    jax.random.fold_in(k_bin, i), batch).astype(jnp.float32)
+            bkey = jax.random.fold_in(key, i)
+            bound, grads = objective_value_and_grad(spec, st.params, cfg, bkey, batch)
+            neg = jax.tree.map(jnp.negative, grads)
+            updates, opt_state = opt.update(neg, st.opt_state, st.params)
+            params = optax.apply_updates(st.params, updates)
+            return TrainState(params, opt_state, st.key, st.step + 1), -bound
+
+        state, losses = lax.scan(body, state, (idx, jnp.arange(n_batches)))
+        return state._replace(key=key), losses
+
+    return jax.jit(epoch, donate_argnums=(0,) if donate else ())
