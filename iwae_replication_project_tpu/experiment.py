@@ -52,34 +52,47 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     ds = load_dataset(cfg.dataset, data_dir=cfg.data_dir,
                       allow_synthetic=cfg.allow_synthetic)
     model_cfg = cfg.model_config()
-    spec = cfg.objective_spec()
     opt = make_adam(eps=cfg.adam_eps)
 
     state = create_train_state(jax.random.PRNGKey(cfg.seed), model_cfg,
                                output_bias=ds.output_bias, optimizer=opt)
 
     mesh = None
-    epoch_fn = None
     if cfg.mesh_dp is not None or cfg.mesh_sp > 1:
-        from iwae_replication_project_tpu.parallel import make_mesh, make_parallel_train_step
-        from iwae_replication_project_tpu.parallel.dp import replicate, shard_batch
+        from iwae_replication_project_tpu.parallel import make_mesh
+        from iwae_replication_project_tpu.parallel.dp import replicate
         mesh = make_mesh(dp=cfg.mesh_dp, sp=cfg.mesh_sp)
-        step_fn = make_parallel_train_step(spec, model_cfg, mesh, optimizer=opt,
-                                           donate=False)
         state = replicate(mesh, state)
-        place = lambda b: shard_batch(mesh, b)  # noqa: E731
     else:
-        # single device: whole-epoch scan (one dispatch per pass over the data)
-        from iwae_replication_project_tpu.training.epoch import make_epoch_fn
         n_train = len(ds.x_train)
         if max_batches_per_pass is not None:
             n_train = min(n_train, max_batches_per_pass * cfg.batch_size)
-        epoch_fn = make_epoch_fn(
-            spec, model_cfg, n_train, cfg.batch_size,
-            stochastic_binarization=ds.binarization == "stochastic",
-            optimizer=opt, donate=False)
         x_train_dev = jax.numpy.asarray(
             ds.x_train[:n_train].reshape(n_train, -1))
+
+    # train functions are built per active objective (objective switching,
+    # PDF Table 10, changes the spec mid-run) and cached
+    _fn_cache = {}
+
+    def train_fns(active_spec):
+        if active_spec in _fn_cache:
+            return _fn_cache[active_spec]
+        if mesh is not None:
+            from iwae_replication_project_tpu.parallel import make_parallel_train_step
+            from iwae_replication_project_tpu.parallel.dp import shard_batch
+            step_fn = make_parallel_train_step(active_spec, model_cfg, mesh,
+                                               optimizer=opt, donate=False)
+            fns = (None, step_fn, lambda b: shard_batch(mesh, b))
+        else:
+            # single device: whole-epoch scan (one dispatch per data pass)
+            from iwae_replication_project_tpu.training.epoch import make_epoch_fn
+            epoch_fn = make_epoch_fn(
+                active_spec, model_cfg, n_train, cfg.batch_size,
+                stochastic_binarization=ds.binarization == "stochastic",
+                optimizer=opt, donate=False)
+            fns = (epoch_fn, None, None)
+        _fn_cache[active_spec] = fns
+        return fns
 
     ckpt_dir = os.path.join(cfg.checkpoint_dir, cfg.run_name())
     start_stage = 1
@@ -99,7 +112,10 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         if stage < start_stage:
             continue
         state = set_learning_rate(state, lr)
-        print(f"stage {stage}: lr={lr:.2e}, {passes} passes")
+        active_spec = cfg.objective_spec(stage)
+        epoch_fn, step_fn, place = train_fns(active_spec)
+        print(f"stage {stage}: lr={lr:.2e}, {passes} passes, "
+              f"objective {active_spec.name} k={active_spec.k}")
         for p in range(passes):
             if epoch_fn is not None:
                 state, _ = epoch_fn(state, x_train_dev)

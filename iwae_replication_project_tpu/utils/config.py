@@ -44,6 +44,12 @@ class ExperimentConfig:
     adam_eps: float = 1e-4
     seed: int = 0
 
+    # objective switching (PDF Table 10, p.13): from `switch_stage` on, train
+    # with `switch_loss` (and `switch_k` if given) instead of `loss_function`.
+    switch_stage: Optional[int] = None
+    switch_loss: Optional[str] = None
+    switch_k: Optional[int] = None
+
     # evaluation (flexible_IWAE.py:496-526)
     eval_k: int = 50
     nll_k: int = 5000
@@ -74,9 +80,15 @@ class ExperimentConfig:
             compute_dtype=self.compute_dtype,
         )
 
-    def objective_spec(self) -> ObjectiveSpec:
-        return ObjectiveSpec(name=self.loss_function, k=self.k, p=self.p,
-                             alpha=self.alpha, beta=self.beta, k2=self.k2)
+    def objective_spec(self, stage: Optional[int] = None) -> ObjectiveSpec:
+        """The objective in effect at `stage` (1-based; None -> the base one)."""
+        name, k = self.loss_function, self.k
+        if (self.switch_stage is not None and stage is not None
+                and stage >= self.switch_stage):
+            name = self.switch_loss or name
+            k = self.switch_k if self.switch_k is not None else k
+        return ObjectiveSpec(name=name, k=k, p=self.p, alpha=self.alpha,
+                             beta=self.beta, k2=self.k2)
 
     def run_name(self) -> str:
         """`IWAE-2L-k_50`-style tag (cf. experiment_example.py:67,95)."""
@@ -105,6 +117,11 @@ def build_argparser() -> argparse.ArgumentParser:
     d = ExperimentConfig()
     ap.add_argument("--config", type=str, default=None,
                     help="JSON config file; CLI flags override it")
+    ap.add_argument("--preset", type=str, default=None,
+                    help="named experiment from the zoo (reference Tables 1-10);"
+                         " CLI flags override it")
+    ap.add_argument("--list-presets", action="store_true", default=False,
+                    help="print all zoo preset names and exit")
     ap.add_argument("--dataset", default=None, type=str)
     ap.add_argument("--data-dir", dest="data_dir", default=None, type=str)
     ap.add_argument("--loss-function", dest="loss_function", default=None, type=str)
@@ -136,7 +153,15 @@ def build_argparser() -> argparse.ArgumentParser:
 def config_from_args(argv=None) -> ExperimentConfig:
     ap = build_argparser()
     ns = ap.parse_args(argv)
-    if ns.config:
+    if ns.list_presets:
+        from iwae_replication_project_tpu import zoo
+        for name in zoo.configs():
+            print(name)
+        raise SystemExit(0)
+    if ns.preset:
+        from iwae_replication_project_tpu import zoo
+        cfg = zoo.get(ns.preset)
+    elif ns.config:
         with open(ns.config) as f:
             cfg = ExperimentConfig.from_json(f.read())
     else:

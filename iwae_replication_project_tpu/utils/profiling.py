@@ -1,0 +1,98 @@
+"""Tracing / profiling / debugging utilities (SURVEY.md §5).
+
+The reference has no profiling or sanitizer hooks at all (its nearest artifact
+is an unused ``plot_model`` import, flexible_IWAE.py:6). Here:
+
+* :func:`trace` — context manager around ``jax.profiler`` emitting a
+  TensorBoard-profile-plugin trace of everything dispatched inside;
+* :class:`StepTimer` — lightweight wall-clock stats for steps/epochs with a
+  one-line summary (p50/p95/max), for spotting dispatch stalls without a full
+  trace;
+* :func:`nan_guard` — context manager flipping ``jax_debug_nans`` so the first
+  NaN-producing primitive raises with a stack trace (the single-threaded
+  JAX analog of the race-detector/sanitizer slot in the survey table);
+* :func:`assert_finite_tree` — chex-based all-finite check over a pytree
+  (params/grads), for use at stage boundaries or in tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional
+
+import chex
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a device+host profile viewable in TensorBoard's profile tab."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def nan_guard(enable: bool = True):
+    """Raise at the first NaN produced by any primitive inside the context.
+
+    Costs extra device syncs — debugging only, not for the hot loop.
+    """
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enable)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def assert_finite_tree(tree, label: str = "tree") -> None:
+    """Raise AssertionError naming `label` if any leaf has a NaN/inf."""
+    try:
+        chex.assert_tree_all_finite(tree)
+    except AssertionError as e:
+        raise AssertionError(f"non-finite values in {label}: {e}") from e
+
+
+class StepTimer:
+    """Wall-clock timing for repeated steps; cheap enough to leave on."""
+
+    def __init__(self, sync_fn=None):
+        self._sync = sync_fn
+        self._durations: List[float] = []
+        self._t0: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._sync is not None:
+            self._sync()
+        self._durations.append(time.perf_counter() - self._t0)
+        self._t0 = None
+        return False
+
+    @property
+    def count(self) -> int:
+        return len(self._durations)
+
+    def summary(self) -> Dict[str, float]:
+        if not self._durations:
+            return {"count": 0}
+        d = sorted(self._durations)
+        n = len(d)
+        return {
+            "count": n,
+            "total_s": sum(d),
+            "mean_s": sum(d) / n,
+            "p50_s": d[n // 2],
+            "p95_s": d[min(n - 1, int(n * 0.95))],
+            "max_s": d[-1],
+        }
+
+    def reset(self):
+        self._durations.clear()

@@ -1,0 +1,130 @@
+"""Zoo + objective switching + profiling utility tests."""
+
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu import zoo
+from iwae_replication_project_tpu.utils.config import ExperimentConfig
+
+
+class TestZoo:
+    def test_all_presets_valid(self):
+        cfgs = zoo.configs()
+        for name, cfg in cfgs.items():
+            cfg.model_config()       # validates architecture lists
+            cfg.objective_spec()     # validates objective name/hparams
+            assert cfg.run_name()
+
+    def test_expected_coverage(self):
+        """Every reference table is represented (BASELINE.md Tables 1-10)."""
+        names = set(zoo.configs())
+        assert sum(n.startswith("table1-") for n in names) == 12
+        assert sum(n.startswith("table2-") for n in names) == 12
+        assert sum(n.startswith("table3-") for n in names) == 8
+        assert sum(n.startswith("table4-") for n in names) == 4
+        assert sum(n.startswith("table5-") for n in names) == 3
+        assert sum(n.startswith("table6-") for n in names) == 1
+        assert sum(n.startswith("table7-") for n in names) == 4
+        assert sum(n.startswith("table8-") for n in names) == 3
+        assert sum(n.startswith("table9-") for n in names) == 4
+        assert sum(n.startswith("table10-") for n in names) == 4
+        assert "northstar-iwae-2l-k50" in names
+        assert "dreg-k50-fashion" in names and "stl-k50-fashion" in names
+
+    def test_northstar_matches_reference_architecture(self):
+        cfg = zoo.get("northstar-iwae-2l-k50")
+        assert cfg.n_hidden_encoder == (200, 100)
+        assert cfg.n_latent_encoder == (100, 50)
+        assert cfg.n_hidden_decoder == (100, 200)
+        assert cfg.n_latent_decoder == (100, 784)
+        assert cfg.loss_function == "IWAE" and cfg.k == 50
+
+    def test_miwae_table9_spec(self):
+        spec = zoo.get("table9-miwae-5x10").objective_spec()
+        assert spec.name == "MIWAE" and spec.k == 50 and spec.k2 == 10
+
+    def test_unknown_preset_suggests(self):
+        with pytest.raises(KeyError, match="did you mean"):
+            zoo.get("table1-iwae-2l-k51")
+
+
+class TestObjectiveSwitching:
+    def test_switch_spec_by_stage(self):
+        cfg = zoo.get("table10-iwae-to-vae-k1")
+        assert cfg.objective_spec(4).name == "IWAE"
+        assert cfg.objective_spec(4).k == 50
+        assert cfg.objective_spec(5).name == "VAE"
+        assert cfg.objective_spec(5).k == 1
+        assert cfg.objective_spec().name == "IWAE"
+
+    def test_switch_in_run_experiment(self, tmp_path):
+        from iwae_replication_project_tpu.experiment import run_experiment
+        cfg = ExperimentConfig(
+            dataset="binarized_mnist", data_dir=str(tmp_path / "d"),
+            n_hidden_encoder=(16,), n_hidden_decoder=(16,),
+            n_latent_encoder=(4,), n_latent_decoder=(784,),
+            loss_function="IWAE", k=4, batch_size=32, n_stages=2,
+            switch_stage=2, switch_loss="VAE", switch_k=2,
+            eval_k=4, nll_k=8, nll_chunk=4, eval_batch_size=16,
+            activity_samples=8,
+            log_dir=str(tmp_path / "runs"), checkpoint_dir=str(tmp_path / "ck"))
+        _, history = run_experiment(cfg, max_batches_per_pass=2, eval_subset=32)
+        assert len(history) == 2
+        assert all(np.isfinite(h[0]["NLL"]) for h in history)
+
+
+class TestPresetCli:
+    def test_preset_flag(self):
+        from iwae_replication_project_tpu.utils.config import config_from_args
+        cfg = config_from_args(["--preset", "table7-power2.0", "--n-stages", "3"])
+        assert cfg.loss_function == "L_power_p" and cfg.p == 2.0
+        assert cfg.n_stages == 3  # CLI override on top of preset
+
+    def test_list_presets_exits(self, capsys):
+        from iwae_replication_project_tpu.utils.config import config_from_args
+        with pytest.raises(SystemExit):
+            config_from_args(["--list-presets"])
+        assert "northstar-iwae-2l-k50" in capsys.readouterr().out
+
+
+class TestProfiling:
+    def test_step_timer(self):
+        from iwae_replication_project_tpu.utils.profiling import StepTimer
+        t = StepTimer()
+        for _ in range(10):
+            with t:
+                pass
+        s = t.summary()
+        assert s["count"] == 10
+        assert s["p50_s"] >= 0 and s["max_s"] >= s["p50_s"]
+        t.reset()
+        assert t.summary() == {"count": 0}
+
+    def test_nan_guard_raises_on_nan(self):
+        import jax
+        import jax.numpy as jnp
+        from iwae_replication_project_tpu.utils.profiling import nan_guard
+        with nan_guard():
+            with pytest.raises(FloatingPointError):
+                jax.jit(lambda v: jnp.log(v))(jnp.asarray(-1.0)).block_until_ready()
+        # restored afterwards
+        assert not jax.config.jax_debug_nans
+
+    def test_assert_finite_tree(self):
+        import jax.numpy as jnp
+        from iwae_replication_project_tpu.utils.profiling import assert_finite_tree
+        assert_finite_tree({"a": jnp.ones(3)}, "params")
+        with pytest.raises(AssertionError, match="grads"):
+            assert_finite_tree({"a": jnp.asarray(float("nan"))}, "grads")
+
+    def test_trace_writes_profile(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        import os
+        from iwae_replication_project_tpu.utils.profiling import trace
+        with trace(str(tmp_path)):
+            jnp.sum(jnp.ones(16)).block_until_ready()
+        found = []
+        for root, _, files in os.walk(tmp_path):
+            found.extend(files)
+        assert found, "no profile artifacts written"
