@@ -63,6 +63,11 @@ class JaxFlexibleModel(FlexibleModel):
             jax.random.PRNGKey(self.seed), self.cfg,
             output_bias=self._output_bias, optimizer=self._optimizer)
         spec = self.objective_spec()
+        if self.mesh is None and self.mesh_sp > 1:
+            # honour the facade's sample-parallelism request: build a mesh with
+            # the requested sp extent, dp absorbing the remaining devices
+            from iwae_replication_project_tpu.parallel import make_mesh
+            self.mesh = make_mesh(sp=self.mesh_sp)
         if self.mesh is not None:
             from iwae_replication_project_tpu.parallel import (
                 dp as pdp, make_parallel_train_step)

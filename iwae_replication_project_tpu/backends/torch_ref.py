@@ -213,6 +213,200 @@ class TorchFlexibleModel(FlexibleModel):
                 print(f"epoch {e + 1}/{epochs}: loss={history['loss'][-1]:.4f}")
         return history
 
+    # ------------------------------------------------------------------
+    # evaluation surface (parity with flexible_IWAE.py:249-302, 466-526)
+    # ------------------------------------------------------------------
+
+    def _generate_from_top(self, h_top):
+        """Ancestral sampling from the deepest latent (flexible_IWAE.py:107-118)."""
+        h = h_top
+        for i in range(self.L - 1):
+            mu, std = self.dec[i](h)
+            h = mu + std * torch.randn(mu.shape)
+        return self._decode_probs(h)
+
+    def reconstructed_x_probs(self, x):
+        """1-sample encode + ancestral decode (flexible_IWAE.py:249-254)."""
+        with torch.no_grad():
+            h, _, _ = self._encode(self._flatten(x), 1)
+            return self._generate_from_top(h[-1])
+
+    def generate(self, n: int):
+        """Prior samples -> pixel probs ``[n, x_dim]``."""
+        with torch.no_grad():
+            h_top = torch.randn(1, n, self.n_latent_encoder[-1])
+            return self._generate_from_top(h_top)[0]
+
+    def get_reconstruction_loss(self, x):
+        """Pixel BCE of the reconstruction (flexible_IWAE.py:256-262)."""
+        x = self._flatten(x)
+        with torch.no_grad():
+            probs = self.reconstructed_x_probs(x)
+            lp = (x * torch.log(probs) + (1 - x) * torch.log1p(-probs)).sum(-1)
+            return -lp.mean()
+
+    def get_E_qhIx_log_pxIh(self, x, n_samples: int):
+        with torch.no_grad():
+            _, aux = self._log_weights_aux(self._flatten(x), n_samples)
+            return aux["log_px_given_h"].mean()
+
+    def get_Dkl_qhIx_ph(self, x, k: int):
+        """E_q[log p(x|h)] - L, one pass (flexible_IWAE.py:414-415)."""
+        with torch.no_grad():
+            lw, aux = self._log_weights_aux(self._flatten(x), k)
+            return aux["log_px_given_h"].mean() - lw.mean()
+
+    def get_Dkl_qhIx_phIx(self, x, k: int):
+        """L_5000 - L (flexible_IWAE.py:411-412)."""
+        with torch.no_grad():
+            return -(self._bound("VAE", x, k) + self.get_NLL(x))
+
+    def get_levels_of_units_activity(self, x, n_samples: int, chunk: int = 10):
+        """MC posterior means -> per-unit variances + PCA eigenvalues
+        (flexible_IWAE.py:264-291), chunked like the reference's 1000 passes."""
+        x = self._flatten(x)
+        with torch.no_grad():
+            sums = [torch.zeros(x.shape[0], d) for d in self.n_latent_encoder]
+            done = 0
+            while done < n_samples:
+                c = min(chunk, n_samples - done)
+                h, _, _ = self._encode(x, c)
+                for j, hj in enumerate(h):
+                    sums[j] += hj.sum(0)
+                done += c
+            means = [s / n_samples for s in sums]
+            variances = [m.var(dim=0, unbiased=False) for m in means]
+            eig = [self.get_eigenvalues_PCA(m) for m in means]
+            return variances, eig
+
+    def get_eigenvalues_PCA(self, data):
+        data = torch.as_tensor(np.asarray(data), dtype=torch.float32)
+        centered = data - data.mean(0)
+        cov = centered.T @ centered / data.shape[0]
+        return torch.linalg.eigvalsh(cov)
+
+    def get_active_units(self, variances, eigen_values, threshold: float = 0.01):
+        masks = [(v > threshold).float() for v in variances]
+        n_active = [int(m.sum()) for m in masks]
+        n_pca = [int((e > threshold).sum()) for e in eigen_values]
+        return masks, n_active, n_pca
+
+    def _masked_log_weights(self, x, masks, k: int):
+        """Inactive coords zeroed after sampling, densities at masked values
+        (flexible_IWAE.py:466-494 semantics, = evaluation/activity.py)."""
+        mu, std = self.enc[0](x)
+        h1 = (mu + std * torch.randn((k,) + mu.shape)) * masks[0]
+        log_q = _normal_log_prob(h1, mu, std).sum(-1)
+        h = [h1]
+        for i in range(1, self.L):
+            mu, std = self.enc[i](h[-1])
+            hi = (mu + std * torch.randn(mu.shape)) * masks[i]
+            log_q = log_q + _normal_log_prob(hi, mu, std).sum(-1)
+            h.append(hi)
+        probs = self._decode_probs(h[0])
+        log_pxIh = (x * torch.log(probs) + (1 - x) * torch.log1p(-probs)).sum(-1)
+        log_ph = (-0.5 * h[-1] ** 2 - 0.5 * float(np.log(2 * np.pi))).sum(-1)
+        for i in range(self.L - 1):
+            mu, std = self.dec[i](h[self.L - 1 - i])
+            log_ph = log_ph + _normal_log_prob(h[self.L - 2 - i], mu, std).sum(-1)
+        return log_ph + log_pxIh - log_q
+
+    def get_NLL_without_inactive_units(self, x, threshold: float = 0.01,
+                                       n_samples: int = 5000,
+                                       activity_samples: int = 1000,
+                                       chunk: int = 100):
+        x = self._flatten(x)
+        variances, eig = self.get_levels_of_units_activity(x, activity_samples)
+        masks, _, _ = self.get_active_units(variances, eig, threshold)
+        chunk = min(chunk, n_samples)
+        with torch.no_grad():
+            m = torch.full((x.shape[0],), -float("inf"))
+            s = torch.zeros(x.shape[0])
+            done = 0
+            while done < n_samples:
+                c = min(chunk, n_samples - done)
+                lw = self._masked_log_weights(x, masks, c)
+                cm = torch.maximum(m, lw.max(0).values)
+                s = s * torch.exp(m - cm) + torch.exp(lw - cm).sum(0)
+                m = cm
+                done += c
+            return -(torch.log(s / n_samples) + m).mean()
+
+    def get_training_statistics(self, x, k: int, batch_size: int = 100,
+                                nll_k: int = 5000, nll_chunk: int = 100,
+                                activity_samples: int = 1000,
+                                activity_threshold: float = 0.01,
+                                include_pruned_nll: bool = True):
+        """Full eval driver, same schema as the JAX path / the reference
+        (flexible_IWAE.py:496-526). One log-weights pass feeds the per-batch
+        scalars (the reference re-encodes ~7x)."""
+        from iwae_replication_project_tpu.evaluation.metrics import (
+            largest_divisor_leq)
+
+        x = self._flatten(x)
+        n = x.shape[0]
+        batch_size = largest_divisor_leq(n, batch_size)
+        nll_chunk = largest_divisor_leq(nll_k, nll_chunk)
+        n_batches = n // batch_size
+
+        acc = {"VAE": 0.0, "IWAE": 0.0, "NLL": 0.0,
+               "E_q(h|x)[log(p(x|h))]": 0.0, "D_kl(q(h|x),p(h))": 0.0,
+               "D_kl(q(h|x),p(h|x))": 0.0, "reconstruction_loss": 0.0}
+        with torch.no_grad():
+            for i in range(n_batches):
+                xb = x[i * batch_size:(i + 1) * batch_size]
+                lw, aux = self._log_weights_aux(xb, k)
+                vae = float(lw.mean())
+                recon_term = float(aux["log_px_given_h"].mean())
+                nll = float(self.get_NLL(xb, k=nll_k, chunk=nll_chunk))
+                acc["VAE"] += vae / n_batches
+                acc["IWAE"] += float(self._iwae(lw)) / n_batches
+                acc["NLL"] += nll / n_batches
+                acc["E_q(h|x)[log(p(x|h))]"] += recon_term / n_batches
+                acc["D_kl(q(h|x),p(h))"] += (recon_term - vae) / n_batches
+                acc["D_kl(q(h|x),p(h|x))"] += (-nll - vae) / n_batches
+                acc["reconstruction_loss"] += float(
+                    self.get_reconstruction_loss(xb)) / n_batches
+
+        variances, eig = self.get_levels_of_units_activity(x, activity_samples)
+        masks, n_active, n_pca = self.get_active_units(variances, eig,
+                                                       activity_threshold)
+        res2 = {"active_units": masks, "number_of_active_units": n_active,
+                "number_of_PCA_active_units": n_pca, "variances": variances}
+        if include_pruned_nll:
+            acc["LL_pruned"] = float(self.get_NLL_without_inactive_units(
+                x[:batch_size], activity_threshold, nll_k, activity_samples,
+                nll_chunk))
+        return acc, res2
+
+    def load_jax_params(self, params) -> "TorchFlexibleModel":
+        """Copy a JAX param pytree (models/iwae.init_params layout) into this
+        oracle — weight-tied cross-backend parity testing. JAX kernels are
+        ``[in, out]``; torch Linear stores ``[out, in]``."""
+        def cp(linear, d):
+            with torch.no_grad():
+                linear.weight.copy_(torch.from_numpy(
+                    np.ascontiguousarray(np.asarray(d["w"]).T)))
+                linear.bias.copy_(torch.from_numpy(np.asarray(d["b"]).copy()))
+
+        for i, blk in enumerate(self.enc):
+            p = params["enc"][i]
+            cp(blk.l1, p["l1"])
+            cp(blk.l2, p["l2"])
+            cp(blk.mu, p["mu"])
+            cp(blk.lstd, p["lstd"])
+        for i, blk in enumerate(self.dec):
+            p = params["dec"][i]
+            cp(blk.l1, p["l1"])
+            cp(blk.l2, p["l2"])
+            cp(blk.mu, p["mu"])
+            cp(blk.lstd, p["lstd"])
+        out = params["out"]
+        cp(self.out[0], out["l1"])
+        cp(self.out[2], out["l2"])
+        cp(self.out[4], out["out"])
+        return self
+
     def get_NLL(self, x, k: int = 5000, chunk: int = 100):
         """Streaming large-k NLL (no_grad, chunked like the JAX path)."""
         if k % chunk != 0:

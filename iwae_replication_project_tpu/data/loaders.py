@@ -44,6 +44,10 @@ class Dataset:
     #: "none" (already binary / leave as-is) or "stochastic" (re-binarize per
     #: batch — the Burda protocol the PDF p.13 flags as the discrepancy).
     binarization: str = "none"
+    #: True when the named dataset was NOT found on disk and deterministic
+    #: synthetic blobs were substituted — downstream results are not
+    #: comparable to any published number.
+    synthetic: bool = False
 
     @property
     def output_bias(self) -> np.ndarray:
@@ -154,7 +158,31 @@ def _synthetic(name: str, n_train: int = 1024, n_test: int = 256,
 # Public registry
 # ---------------------------------------------------------------------------
 
-DATASETS = ("binarized_mnist", "mnist", "fashion_mnist", "omniglot")
+DATASETS = ("binarized_mnist", "mnist", "fashion_mnist", "omniglot", "digits")
+
+
+def _load_sklearn_digits(seed: int = 0) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """REAL handwritten-digit data that ships inside scikit-learn (UCI
+    optdigits, 1797 8x8 grayscale images) — the only real image dataset
+    available in this zero-egress environment.
+
+    Prepared to mirror the fixed-binarization MNIST protocol (PDF §3.1):
+    nearest-neighbor upsample 8x8 -> 32x32, center-crop to 28x28, then ONE
+    deterministic Bernoulli binarization (Larochelle-style fixed draw).
+    Returns ``(x_train_bin, x_test_bin, raw_train_means)`` — the raw grayscale
+    means feed the bias init, reproducing the reference's raw-means-for-
+    fixed-bin policy (flexible_IWAE.py:150-155).
+    """
+    from sklearn.datasets import load_digits as _sk_load_digits
+
+    d = _sk_load_digits()
+    gray = d.images.astype(np.float32) / 16.0  # [1797, 8, 8] in [0, 1]
+    up = np.repeat(np.repeat(gray, 4, axis=1), 4, axis=2)  # [N, 32, 32]
+    up = up[:, 2:30, 2:30].reshape(-1, X_DIM)  # center-crop -> [N, 784]
+    rs = np.random.RandomState(seed)
+    binary = (rs.uniform(size=up.shape) < up).astype(np.float32)
+    n_train = 1500
+    return binary[:n_train], binary[n_train:], up[:n_train].mean(axis=0)
 
 _MNIST_TRAIN = ["train-images-idx3-ubyte", "train-images-idx3-ubyte.gz"]
 _MNIST_TEST = ["t10k-images-idx3-ubyte", "t10k-images-idx3-ubyte.gz"]
@@ -196,19 +224,36 @@ def load_dataset(name: str, data_dir: str = "data", allow_synthetic: bool = True
         if pair is None and name == "mnist":
             pair = _load_idx_pair(data_dir, _MNIST_TRAIN, _MNIST_TEST)
         binarization = "stochastic"
-    else:  # omniglot
+    elif name == "omniglot":
         pair = _load_omniglot_mat(data_dir) or _load_npz(data_dir, ["omniglot.npz"])
         binarization = "stochastic"
+    else:  # digits: bundled with scikit-learn, needs no data_dir
+        xtr, xte, raw_means = _load_sklearn_digits()
+        pair = (xtr, xte)
+        bias_means = raw_means
+        binarization = "none"
 
+    synthetic = False
     if pair is None:
         if not allow_synthetic:
             raise FileNotFoundError(
                 f"dataset {name!r} not found under {data_dir!r} and synthetic "
                 f"fallback disabled")
+        synthetic = True
+        import sys
+        msg = (f"dataset {name!r} NOT FOUND under {data_dir!r} — substituting "
+               f"SYNTHETIC blobs. Results are NOT comparable to published "
+               f"numbers. Place real files in {data_dir!r} (see data/loaders.py "
+               f"docstring / scripts/prepare_data.py) or pass "
+               f"allow_synthetic=False to fail instead.")
+        banner = "=" * 78
+        print(f"{banner}\nWARNING: {msg}\n{banner}", file=sys.stderr, flush=True)
+        print(f"WARNING: {msg}", flush=True)
         pair = _synthetic(name, *synthetic_sizes)
 
     x_train, x_test = pair
     if bias_means is None:
         bias_means = x_train.mean(axis=0)
     return Dataset(name=name, x_train=x_train, x_test=x_test,
-                   bias_means=bias_means, binarization=binarization)
+                   bias_means=bias_means, binarization=binarization,
+                   synthetic=synthetic)

@@ -21,7 +21,6 @@ import jax
 import numpy as np
 
 from iwae_replication_project_tpu.data import load_dataset, epoch_batches
-from iwae_replication_project_tpu.evaluation.metrics import largest_divisor_leq
 from iwae_replication_project_tpu.evaluation import metrics as ev
 from iwae_replication_project_tpu.training import (
     burda_stages,
@@ -57,47 +56,48 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     state = create_train_state(jax.random.PRNGKey(cfg.seed), model_cfg,
                                output_bias=ds.output_bias, optimizer=opt)
 
+    n_train = len(ds.x_train)
+    if max_batches_per_pass is not None:
+        n_train = min(n_train, max_batches_per_pass * cfg.batch_size)
+    x_train_dev = jax.numpy.asarray(ds.x_train[:n_train].reshape(n_train, -1))
+
     mesh = None
     if cfg.mesh_dp is not None or cfg.mesh_sp > 1:
         from iwae_replication_project_tpu.parallel import make_mesh
         from iwae_replication_project_tpu.parallel.dp import replicate
         mesh = make_mesh(dp=cfg.mesh_dp, sp=cfg.mesh_sp)
         state = replicate(mesh, state)
-    else:
-        n_train = len(ds.x_train)
-        if max_batches_per_pass is not None:
-            n_train = min(n_train, max_batches_per_pass * cfg.batch_size)
-        x_train_dev = jax.numpy.asarray(
-            ds.x_train[:n_train].reshape(n_train, -1))
+        x_train_dev = replicate(mesh, x_train_dev)
 
     # train functions are built per active objective (objective switching,
-    # PDF Table 10, changes the spec mid-run) and cached
+    # PDF Table 10, changes the spec mid-run) and cached. Either way a data
+    # pass is ONE compiled dispatch (whole-epoch lax.scan — training/epoch.py
+    # single-device, parallel/dp.py under the mesh).
     _fn_cache = {}
 
-    def train_fns(active_spec):
+    def epoch_fn_for(active_spec):
         if active_spec in _fn_cache:
             return _fn_cache[active_spec]
         if mesh is not None:
-            from iwae_replication_project_tpu.parallel import make_parallel_train_step
-            from iwae_replication_project_tpu.parallel.dp import shard_batch
-            step_fn = make_parallel_train_step(active_spec, model_cfg, mesh,
-                                               optimizer=opt, donate=False)
-            fns = (None, step_fn, lambda b: shard_batch(mesh, b))
+            from iwae_replication_project_tpu.parallel.dp import make_parallel_epoch_fn
+            fn = make_parallel_epoch_fn(
+                active_spec, model_cfg, mesh, n_train, cfg.batch_size,
+                stochastic_binarization=ds.binarization == "stochastic",
+                optimizer=opt, donate=False)
         else:
-            # single device: whole-epoch scan (one dispatch per data pass)
             from iwae_replication_project_tpu.training.epoch import make_epoch_fn
-            epoch_fn = make_epoch_fn(
+            fn = make_epoch_fn(
                 active_spec, model_cfg, n_train, cfg.batch_size,
                 stochastic_binarization=ds.binarization == "stochastic",
                 optimizer=opt, donate=False)
-            fns = (epoch_fn, None, None)
-        _fn_cache[active_spec] = fns
-        return fns
+        _fn_cache[active_spec] = fn
+        return fn
 
     ckpt_dir = os.path.join(cfg.checkpoint_dir, cfg.run_name())
     start_stage = 1
     if cfg.resume:
-        restored = restore_latest(ckpt_dir, state)
+        restored = restore_latest(ckpt_dir, state,
+                                  expect_config_json=cfg.to_json())
         if restored is not None:
             _, state, start_stage = restored
             start_stage += 1
@@ -113,33 +113,45 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
             continue
         state = set_learning_rate(state, lr)
         active_spec = cfg.objective_spec(stage)
-        epoch_fn, step_fn, place = train_fns(active_spec)
+        epoch_fn = epoch_fn_for(active_spec)
         print(f"stage {stage}: lr={lr:.2e}, {passes} passes, "
               f"objective {active_spec.name} k={active_spec.k}")
         for p in range(passes):
-            if epoch_fn is not None:
-                state, _ = epoch_fn(state, x_train_dev)
-            else:
-                for bi, batch in enumerate(epoch_batches(
-                        ds.x_train, cfg.batch_size, epoch=int(state.step),
-                        seed=cfg.seed, binarization=ds.binarization)):
-                    if max_batches_per_pass is not None and bi >= max_batches_per_pass:
-                        break
-                    state, metrics = step_fn(state, place(batch))
+            state, _ = epoch_fn(state, x_train_dev)
 
-        res, res2 = ev.training_statistics(
-            state.params, model_cfg, jax.random.fold_in(eval_key, stage),
-            jax.numpy.asarray(x_test.reshape(len(x_test), -1)),
-            cfg.eval_k, batch_size=min(cfg.eval_batch_size, len(x_test)),
-            nll_k=cfg.nll_k, nll_chunk=cfg.nll_chunk,
-            activity_samples=cfg.activity_samples)
+        if mesh is not None:
+            from iwae_replication_project_tpu.parallel.eval import (
+                parallel_training_statistics)
+            res, res2 = parallel_training_statistics(
+                state.params, model_cfg, mesh,
+                jax.random.fold_in(eval_key, stage),
+                jax.numpy.asarray(x_test.reshape(len(x_test), -1)),
+                cfg.eval_k, batch_size=min(cfg.eval_batch_size, len(x_test)),
+                nll_k=cfg.nll_k, nll_chunk=cfg.nll_chunk,
+                activity_samples=cfg.activity_samples)
+        else:
+            res, res2 = ev.training_statistics(
+                state.params, model_cfg, jax.random.fold_in(eval_key, stage),
+                jax.numpy.asarray(x_test.reshape(len(x_test), -1)),
+                cfg.eval_k, batch_size=min(cfg.eval_batch_size, len(x_test)),
+                nll_k=cfg.nll_k, nll_chunk=cfg.nll_chunk,
+                activity_samples=cfg.activity_samples)
         res["learning_rate"] = lr
         res["stage"] = stage
+        # make fake-data runs unmistakable in every artifact (metrics.jsonl,
+        # results.pkl, stdout)
+        res["synthetic_data"] = bool(ds.synthetic)
         print({k: round(v, 4) for k, v in res.items() if isinstance(v, float)})
         logger.log(res, step=int(state.step))
         results_history.append((res, {
             "number_of_active_units": res2["number_of_active_units"],
             "number_of_PCA_active_units": res2["number_of_PCA_active_units"]}))
+
+        if cfg.save_figures:
+            from iwae_replication_project_tpu.utils.viz import save_stage_figures
+            save_stage_figures(state.params, model_cfg,
+                               jax.random.fold_in(eval_key, 10_000 + stage),
+                               x_test, logger.dir, stage)
 
         save_checkpoint(ckpt_dir, int(state.step), state, stage,
                         config_json=cfg.to_json(), keep=cfg.checkpoint_keep)
@@ -153,9 +165,10 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
 def _run_experiment_torch(cfg: ExperimentConfig,
                           max_batches_per_pass: Optional[int] = None,
                           eval_subset: Optional[int] = None):
-    """The staged experiment on the eager-CPU oracle backend (reduced eval:
-    the bounds + streaming NLL; no active-unit suite, no checkpoint/resume).
-    Mirrors how the reference's eager path would run the same loop."""
+    """The staged experiment on the eager-CPU oracle backend, with the FULL
+    evaluation suite (training statistics incl. activity + pruned NLL —
+    parity with flexible_IWAE.py:496-526). No checkpoint/resume (the
+    reference's eager path had none either)."""
     import torch
 
     from iwae_replication_project_tpu.api import FlexibleModel
@@ -182,17 +195,19 @@ def _run_experiment_torch(cfg: ExperimentConfig,
                     break
                 mdl.train_step(torch.from_numpy(batch))
                 step_count += 1
-        res = {
-            "VAE": float(mdl.get_L(x_test, cfg.eval_k)),
-            "IWAE": float(mdl.get_L_k(x_test, cfg.eval_k)),
-            "NLL": float(mdl.get_NLL(x_test, k=cfg.nll_k,
-                                     chunk=largest_divisor_leq(cfg.nll_k,
-                                                               cfg.nll_chunk))),
-            "learning_rate": lr, "stage": stage,
-        }
-        print(res)
+        res, res2 = mdl.get_training_statistics(
+            x_test, cfg.eval_k,
+            batch_size=min(cfg.eval_batch_size, len(x_test)),
+            nll_k=cfg.nll_k, nll_chunk=cfg.nll_chunk,
+            activity_samples=cfg.activity_samples)
+        res["learning_rate"] = lr
+        res["stage"] = stage
+        res["synthetic_data"] = bool(ds.synthetic)
+        print({k: round(v, 4) for k, v in res.items() if isinstance(v, float)})
         logger.log(res, step=step_count)
-        results_history.append((res, {}))
+        results_history.append((res, {
+            "number_of_active_units": res2["number_of_active_units"],
+            "number_of_PCA_active_units": res2["number_of_PCA_active_units"]}))
     logger.close()
     return mdl, results_history
 

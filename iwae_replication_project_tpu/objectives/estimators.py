@@ -120,7 +120,19 @@ def vae_v1_bound(log_px_given_h: jnp.ndarray, q_mu: jnp.ndarray,
 
     ``E_q[log p(x|h)] - mean_B sum_d KL(q(h|x) || N(0,1))`` — the MC-vs-analytic
     consistency oracle the reference ships as its only built-in test.
+
+    Defined for SINGLE-stochastic-layer models only (the reference's comment
+    at flexible_IWAE.py:433): with L>=2 the last conditional's KL against a
+    standard Normal is not the model's KL term, so the "analytic" value would
+    be wrong by construction. A multi-layer encoder is detected by the sample
+    axis on ``q_mu`` (layer-1 params are [B, d]; deeper layers' depend on the
+    k sampled ancestors -> [k, B, d]) and rejected.
     """
+    if q_mu.ndim != 2:
+        raise ValueError(
+            "VAE_V1's analytic KL is defined for single-stochastic-layer "
+            "models only (flexible_IWAE.py:433); this encoder has L >= 2 — "
+            "use VAE (the MC estimator) instead")
     recon = jnp.mean(log_px_given_h)
     kl = jnp.mean(jnp.sum(dist.normal_kl_standard(q_mu, q_std), axis=-1))
     return recon - kl

@@ -1,6 +1,8 @@
 from iwae_replication_project_tpu.parallel.mesh import make_mesh, MeshAxes
 from iwae_replication_project_tpu.parallel.dp import (
+    make_parallel_epoch_fn,
     make_parallel_train_step,
+    make_parallel_value_and_grad,
     shard_batch,
     distributed_logmeanexp,
 )
@@ -9,7 +11,9 @@ from iwae_replication_project_tpu.parallel.auto import make_pjit_train_step
 __all__ = [
     "make_mesh",
     "MeshAxes",
+    "make_parallel_epoch_fn",
     "make_parallel_train_step",
+    "make_parallel_value_and_grad",
     "shard_batch",
     "distributed_logmeanexp",
     "make_pjit_train_step",

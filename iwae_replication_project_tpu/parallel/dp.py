@@ -34,10 +34,13 @@ from iwae_replication_project_tpu.objectives import (
 from iwae_replication_project_tpu.parallel.mesh import AXES
 from iwae_replication_project_tpu.training.train_step import TrainState, make_adam
 
-#: objectives whose bound decomposes over a sharded k axis via a global
-#: logmeanexp / mean. L_median needs a global median (not shardable this way);
-#: the gradient-estimator family would need globally-normalized cotangents.
-SP_SHARDABLE = ("IWAE", "VAE", "CIWAE", "L_power_p", "MIWAE")
+#: every objective supports sp (k-axis) sharding. Most decompose via a global
+#: logmeanexp / mean with O(B) collectives; L_median all_gathers the sharded k
+#: axis (O(k*B) over ICI — the only estimator needing the full weight vector);
+#: DReG/STL/PIWAE use globally-normalized softmax cotangents (one psum of the
+#: per-shard denominators, _make_sharded_gradient_estimator).
+SP_SHARDABLE = ("IWAE", "VAE", "VAE_V1", "L_alpha", "CIWAE", "L_power_p",
+                "L_median", "MIWAE", "PIWAE", "DReG", "STL")
 
 
 def distributed_logmeanexp(log_w_local: jax.Array, axis_name: str, k_global: int,
@@ -75,12 +78,39 @@ def _sharded_bound(spec: ObjectiveSpec, log_w_local: jax.Array, aux: dict,
         from iwae_replication_project_tpu.ops.logsumexp import logmeanexp
         grouped = log_w_local.reshape(-1, spec.k // spec.k2, *log_w_local.shape[1:])
         return jnp.mean(lax.pmean(jnp.mean(logmeanexp(grouped, axis=1), axis=0), AXES.sp))
+    if name == "L_median":
+        # the one estimator that needs the full per-example weight vector: one
+        # all_gather over sp (shard order matches a single-device concat)
+        lw_full = lax.all_gather(log_w_local, AXES.sp, axis=0, tiled=True)
+        return est.median_bound(lw_full)
+    if name == "L_alpha":
+        recon = jnp.mean(
+            lax.psum(jnp.sum(aux["log_px_given_h"], axis=0), AXES.sp) / k_global)
+        vae = jnp.mean(lax.psum(jnp.sum(log_w_local, axis=0), AXES.sp) / k_global)
+        return (1.0 - spec.alpha) * recon + spec.alpha * vae
+    if name == "VAE_V1":
+        # analytic KL is k-independent ([B, d] for the single-layer model this
+        # oracle is defined on — multi-layer is rejected like est.vae_v1_bound);
+        # only the recon MC average couples over sp
+        q_mu, q_std = aux["q_last"]
+        if q_mu.ndim != 2:
+            raise ValueError(
+                "VAE_V1 is single-stochastic-layer only (flexible_IWAE.py:433)")
+        recon = jnp.mean(
+            lax.psum(jnp.sum(aux["log_px_given_h"], axis=0), AXES.sp) / k_global)
+        from iwae_replication_project_tpu.ops import distributions as dist
+        kl = jnp.mean(jnp.sum(dist.normal_kl_standard(q_mu, q_std), axis=-1))
+        return recon - kl
     raise ValueError(f"objective {name!r} is not sample-parallel shardable; "
                      f"use sp=1 (supported: {SP_SHARDABLE})")
 
 
 def shard_batch(mesh, batch: jax.Array) -> jax.Array:
     """Place a host batch with the leading axis sharded over dp, replicated over sp."""
+    n_dp = mesh.shape[AXES.dp]
+    if batch.shape[0] % n_dp != 0:
+        raise ValueError(
+            f"batch size {batch.shape[0]} must be divisible by dp={n_dp}")
     return jax.device_put(batch, NamedSharding(mesh, P(AXES.dp)))
 
 
@@ -88,24 +118,85 @@ def replicate(mesh, tree):
     return jax.device_put(tree, NamedSharding(mesh, P()))
 
 
-def make_parallel_train_step(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
-                             optimizer: optax.GradientTransformation | None = None,
-                             donate: bool = True):
-    """Build the SPMD train step: ``(state, sharded_batch) -> (state, metrics)``.
-
-    `state` is replicated; the batch is sharded ``P('dp')``. Each device folds
-    its (dp, sp) coordinates into the RNG so sample draws are independent
-    across both the batch shards and the k shards.
-    """
-    opt = optimizer if optimizer is not None else make_adam()
+def _validate_sharding(spec: ObjectiveSpec, mesh, batch_size: int | None) -> Tuple[int, int]:
+    """Build-time divisibility/support checks; returns ``(n_sp, k_local)``."""
     n_sp = mesh.shape[AXES.sp]
+    n_dp = mesh.shape[AXES.dp]
     if n_sp > 1 and spec.name not in SP_SHARDABLE:
         raise ValueError(f"objective {spec.name!r} does not support sp>1")
     if spec.k % n_sp != 0:
         raise ValueError(f"sp={n_sp} must divide k={spec.k}")
-    if spec.name == "MIWAE" and n_sp > 1 and spec.k2 % n_sp != 0:
-        raise ValueError(f"MIWAE with sp={n_sp} needs sp | k2={spec.k2}")
-    k_local = spec.k // n_sp
+    if spec.name in ("MIWAE", "PIWAE") and n_sp > 1 and spec.k2 % n_sp != 0:
+        raise ValueError(f"{spec.name} with sp={n_sp} needs sp | k2={spec.k2}")
+    if batch_size is not None and batch_size % n_dp != 0:
+        raise ValueError(
+            f"batch_size={batch_size} must be divisible by dp={n_dp}")
+    return n_sp, spec.k // n_sp
+
+
+def _make_sharded_gradient_estimator(spec: ObjectiveSpec, cfg: model.ModelConfig,
+                                     n_sp: int, k_local: int):
+    """DReG / STL / PIWAE with the k axis sharded over sp.
+
+    These estimators prescribe explicit VJP cotangents built from the
+    *globally* self-normalized weights ``w~ = softmax_k(log w)`` (see
+    objectives/gradients.py for the single-device math). Under sp sharding the
+    normalization needs exactly two collectives — a pmax of the per-shard
+    maxima and a psum of the per-shard exp-sums — after which each device
+    applies its local cotangent slice and the partial grads sum over sp.
+    Returns ``(bound, grads)`` with grads ALREADY psum'd over sp (true
+    partials, no transpose factor: the collectives sit on the stop_grad side).
+    """
+    from iwae_replication_project_tpu.objectives.gradients import _select
+
+    k_global = spec.k
+
+    def vg(params, subkey, x_local):
+        B = x_local.shape[0]
+        stop_q = spec.name in ("DReG", "STL")
+
+        def log_w_fn(p):
+            return model.log_weights(p, cfg, subkey, x_local, k_local,
+                                     stop_q_score=stop_q)
+
+        log_w, vjp = jax.vjp(log_w_fn, params)
+        lw_sg = lax.stop_gradient(log_w)
+        m = lax.pmax(jnp.max(lw_sg, axis=0), AXES.sp)
+        e = jnp.exp(lw_sg - m)
+        denom = lax.psum(jnp.sum(e, axis=0), AXES.sp)
+        w_tilde = e / denom  # [k_local, B], globally normalized
+        bound = jnp.mean(jnp.log(denom) + m - jnp.log(float(k_global)))
+
+        if spec.name == "STL":
+            (grads,) = vjp(w_tilde / B)
+        elif spec.name == "DReG":
+            (g_enc,) = vjp(jnp.square(w_tilde) / B)
+            (g_dec,) = vjp(w_tilde / B)
+            grads = _select(g_enc, g_dec, take_enc_from_a=True)
+        else:  # PIWAE: decoder on IWAE(k), encoder on MIWAE(k1, k2)
+            k2_local = spec.k2 // n_sp  # sp | k2 validated at build
+            grouped = lw_sg.reshape(k2_local, k_local // k2_local,
+                                    *lw_sg.shape[1:])
+            ct_enc = (jax.nn.softmax(grouped, axis=1)
+                      .reshape(lw_sg.shape) / (spec.k2 * B))
+            (g_dec,) = vjp(w_tilde / B)
+            (g_enc,) = vjp(ct_enc)
+            grads = _select(g_enc, g_dec, take_enc_from_a=True)
+
+        grads = jax.tree.map(lambda g: lax.psum(g, AXES.sp), grads)
+        return bound, grads
+
+    return vg
+
+
+def _make_local_value_and_grad(spec: ObjectiveSpec, cfg: model.ModelConfig,
+                               n_sp: int, k_local: int):
+    """The per-device (bound, grads) computation, *including* the collectives.
+
+    `subkey` must already be folded per-(dp, sp) coordinate. Outputs are
+    replicated: grads are psum'd over sp (sample-shard contributions) and
+    pmean'd over dp (batch-shard average); the bound is pmean'd over dp.
+    """
 
     def local_loss(params, key, x_local):
         log_w, aux = model.log_weights_and_aux(params, cfg, key, x_local, k_local)
@@ -113,20 +204,147 @@ def make_parallel_train_step(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
             return est.bound_from_log_weights(spec, log_w, aux)
         return _sharded_bound(spec, log_w, aux, spec.k)
 
+    sharded_estimator = (_make_sharded_gradient_estimator(spec, cfg, n_sp, k_local)
+                         if spec.name in ("DReG", "STL", "PIWAE") and n_sp > 1
+                         else None)
+
+    def value_and_grad(params, subkey, x_local):
+        if spec.name in ("DReG", "STL", "PIWAE"):
+            if n_sp == 1:
+                # modified-gradient estimators: their custom VJP-cotangent path
+                bound, grads = objective_value_and_grad(spec, params, cfg,
+                                                        subkey, x_local)
+            else:
+                # sharded cotangents; grads arrive already psum'd over sp
+                bound, grads = sharded_estimator(params, subkey, x_local)
+        else:
+            bound, grads = jax.value_and_grad(local_loss)(params, subkey, x_local)
+            # Under shard_map, transpose(psum) = psum: differentiating the
+            # sp-coupled loss (whose value psums/all_gathers over sp) hands
+            # every device a cotangent that is already summed over sp, i.e.
+            # each local grad is n_sp x its true partial. pmean over sp (NOT
+            # psum) therefore recovers the exact sum of partials. Verified
+            # numerically against a matched-RNG single-device reference in
+            # tests/test_parallel.py.
+        grads = jax.tree.map(lambda g: lax.pmean(g, AXES.sp), grads)
+        # dp is uncoupled in-value: plain batch-shard average
+        grads = jax.tree.map(lambda g: lax.pmean(g, AXES.dp), grads)
+        bound = lax.pmean(bound, AXES.dp)
+        return bound, grads
+
+    return value_and_grad
+
+
+def _fold_axis_coords(key: jax.Array) -> jax.Array:
+    """Independent noise per (dp, sp) mesh coordinate."""
+    key = jax.random.fold_in(key, lax.axis_index(AXES.dp))
+    return jax.random.fold_in(key, lax.axis_index(AXES.sp))
+
+
+def make_parallel_value_and_grad(spec: ObjectiveSpec, cfg: model.ModelConfig,
+                                 mesh, batch_size: int | None = None):
+    """``(params, key, sharded_batch) -> (bound, grads)``, both replicated.
+
+    The exact collective composition the train step uses, exposed standalone so
+    tests can assert numeric equivalence against a single-device reference that
+    folds the same (dp, sp) indices into the same key (tests/test_parallel.py).
+    """
+    n_sp, k_local = _validate_sharding(spec, mesh, batch_size)
+    vg = _make_local_value_and_grad(spec, cfg, n_sp, k_local)
+
+    def spmd_vg(params, key, x_local):
+        return vg(params, _fold_axis_coords(key), x_local)
+
+    return jax.jit(shard_map(
+        spmd_vg, mesh=mesh,
+        in_specs=(P(), P(), P(AXES.dp)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    ))
+
+
+def make_parallel_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
+                           n_train: int, batch_size: int,
+                           stochastic_binarization: bool = False,
+                           optimizer: optax.GradientTransformation | None = None,
+                           shuffle: bool = True, donate: bool = True):
+    """Whole-epoch training under the mesh: ONE dispatch per data pass.
+
+    The single-device path already runs each epoch as one `lax.scan`
+    (training/epoch.py) because per-step Python dispatch dominates at this
+    model scale; this is the same design *inside* shard_map, so multi-chip
+    training keeps that property instead of regressing to per-batch dispatch.
+
+    `x_train` is replicated (MNIST-scale data is far below HBM limits; a
+    replicated store makes the reference's *global* shuffle semantics exact —
+    every device computes the same permutation from the same key and gathers
+    its own batch slice locally, no collectives in the data path). Stochastic
+    binarization is keyed per (batch, dp) but NOT per sp, so all k-shards of a
+    sample see the same binarized pixels, exactly like the host pipeline.
+
+    Returns ``epoch(state, x_train_replicated) -> (state, per-batch losses)``.
+    """
+    opt = optimizer if optimizer is not None else make_adam()
+    n_sp, k_local = _validate_sharding(spec, mesh, batch_size)
+    n_dp = mesh.shape[AXES.dp]
+    n_batches = n_train // batch_size
+    if n_batches == 0:
+        raise ValueError(f"batch_size={batch_size} exceeds n_train={n_train}")
+    b_local = batch_size // n_dp
+    vg = _make_local_value_and_grad(spec, cfg, n_sp, k_local)
+
+    def epoch_local(state: TrainState, x_train):
+        key_next, k_batch, k_perm, k_bin = jax.random.split(state.key, 4)
+        if shuffle:
+            perm = jax.random.permutation(k_perm, n_train)
+        else:
+            perm = jnp.arange(n_train)
+        idx = perm[: n_batches * batch_size].reshape(n_batches, batch_size)
+        i_dp = lax.axis_index(AXES.dp)
+
+        def body(st, xs):
+            batch_idx, i = xs
+            local_idx = lax.dynamic_slice(batch_idx, (i_dp * b_local,), (b_local,))
+            batch = x_train[local_idx]
+            if stochastic_binarization:
+                bin_key = jax.random.fold_in(jax.random.fold_in(k_bin, i), i_dp)
+                batch = jax.random.bernoulli(bin_key, batch).astype(jnp.float32)
+            bkey = _fold_axis_coords(jax.random.fold_in(k_batch, i))
+            bound, grads = vg(st.params, bkey, batch)
+            neg = jax.tree.map(jnp.negative, grads)
+            updates, opt_state = opt.update(neg, st.opt_state, st.params)
+            params = optax.apply_updates(st.params, updates)
+            return TrainState(params, opt_state, st.key, st.step + 1), -bound
+
+        state, losses = lax.scan(body, state, (idx, jnp.arange(n_batches)))
+        return state._replace(key=key_next), losses
+
+    sharded = shard_map(
+        epoch_local, mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_parallel_train_step(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
+                             optimizer: optax.GradientTransformation | None = None,
+                             donate: bool = True, batch_size: int | None = None):
+    """Build the SPMD train step: ``(state, sharded_batch) -> (state, metrics)``.
+
+    `state` is replicated; the batch is sharded ``P('dp')``. Each device folds
+    its (dp, sp) coordinates into the RNG so sample draws are independent
+    across both the batch shards and the k shards. Pass `batch_size` to
+    fail fast at build time on indivisible batch sharding.
+    """
+    opt = optimizer if optimizer is not None else make_adam()
+    n_sp, k_local = _validate_sharding(spec, mesh, batch_size)
+    vg = _make_local_value_and_grad(spec, cfg, n_sp, k_local)
+
     def spmd_step(state: TrainState, x_local):
         key, subkey = jax.random.split(state.key)
-        # independent noise per (dp, sp) coordinate
-        subkey = jax.random.fold_in(subkey, lax.axis_index(AXES.dp))
-        subkey = jax.random.fold_in(subkey, lax.axis_index(AXES.sp))
-        if n_sp == 1 and spec.name in ("DReG", "STL", "PIWAE"):
-            # modified-gradient estimators: their custom VJP-cotangent path
-            bound, grads = objective_value_and_grad(spec, state.params, cfg,
-                                                    subkey, x_local)
-        else:
-            bound, grads = jax.value_and_grad(local_loss)(state.params, subkey, x_local)
-        # sum sample-shard contributions, average batch shards
-        grads = jax.tree.map(lambda g: lax.pmean(lax.psum(g, AXES.sp), AXES.dp), grads)
-        bound = lax.pmean(bound, AXES.dp)
+        bound, grads = vg(state.params, _fold_axis_coords(subkey), x_local)
         neg_grads = jax.tree.map(jnp.negative, grads)
         updates, opt_state = opt.update(neg_grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)

@@ -1,0 +1,296 @@
+"""Mesh-sharded evaluation: the k=5000 NLL and the full statistics suite.
+
+The reference's evaluation is its memory/compute hot spot — `get_NLL` draws
+k=5000 samples per test point as one eager ``[5000, B, 784]`` tensor
+(flexible_IWAE.py:463,515) and the activity suite runs 1000 full-test-set
+encoder passes (:270-273). The single-device path already streams these
+(evaluation/metrics.py); here the same reductions are *distributed* over the
+``(dp, sp)`` mesh:
+
+* test batches shard over ``dp``;
+* the k sample axis shards over ``sp`` — each device streams ``k/sp`` samples
+  through the online-logsumexp carry, and the carries merge across ``sp`` with
+  one ``pmax`` + one ``psum`` (O(B) bytes over ICI, the associative-merge form
+  of ops.logsumexp.online_logsumexp_merge);
+* the activity estimator shards its ``n_samples`` Monte-Carlo passes over ALL
+  devices (dp*sp), psum-ing the posterior-mean sums.
+
+Output schema matches evaluation.metrics.training_statistics, which matches
+the reference (flexible_IWAE.py:496-526).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from iwae_replication_project_tpu.evaluation import activity as au
+from iwae_replication_project_tpu.evaluation.metrics import largest_divisor_leq
+from iwae_replication_project_tpu.models import iwae as model
+from iwae_replication_project_tpu.ops import distributions as dist
+from iwae_replication_project_tpu.ops.logsumexp import (
+    online_logsumexp_init,
+    online_logsumexp_update,
+)
+from iwae_replication_project_tpu.parallel.dp import (
+    _fold_axis_coords,
+    distributed_logmeanexp,
+)
+from iwae_replication_project_tpu.parallel.mesh import AXES
+
+
+def _merge_lse_over_sp(state):
+    """Cross-device form of online_logsumexp_merge: one pmax + one psum."""
+    m_g = lax.pmax(state.m, AXES.sp)
+    safe = jnp.where(jnp.isfinite(m_g), m_g, 0.0)
+    s_g = lax.psum(state.s * jnp.exp(state.m - safe), AXES.sp)
+    return m_g, safe, s_g
+
+
+@functools.lru_cache(maxsize=32)
+def make_parallel_streaming_log_px(cfg: model.ModelConfig, mesh, k: int = 5000,
+                                   chunk: int = 100):
+    """``(params, key, x) -> [B] log p̂(x)`` with batch over dp, k over sp.
+
+    Each device scans ``k/sp`` fresh importance samples in `chunk`-sized
+    blocks through the online-logsumexp carry; the per-device carries merge
+    across sp at the end. Per-device RNG folds (chunk index, dp, sp) so all
+    ``k`` global samples are independent.
+    """
+    n_sp = mesh.shape[AXES.sp]
+    if k % n_sp != 0:
+        raise ValueError(f"sp={n_sp} must divide eval k={k}")
+    k_local = k // n_sp
+    chunk = largest_divisor_leq(k_local, chunk)
+
+    def local_fn(params, key, x_local):
+        key = _fold_axis_coords(key)
+
+        def body(state, i):
+            lw = model.log_weights(params, cfg, jax.random.fold_in(key, i),
+                                   x_local, chunk)
+            return online_logsumexp_update(state, lw, axis=0), None
+
+        init = online_logsumexp_init((x_local.shape[0],))
+        state, _ = lax.scan(body, init, jnp.arange(k_local // chunk))
+        _, safe, s_g = _merge_lse_over_sp(state)
+        return jnp.log(s_g) + safe - jnp.log(float(k))
+
+    return jax.jit(shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(), P(), P(AXES.dp)),
+        out_specs=P(AXES.dp),
+        check_vma=False,
+    ))
+
+
+@functools.lru_cache(maxsize=32)
+def make_parallel_batch_metrics(cfg: model.ModelConfig, mesh, k: int):
+    """Sharded single-pass metric bundle (cf. evaluation.metrics.batch_metrics):
+    batch over dp, the k fan-out over sp, scalars replicated."""
+    n_sp = mesh.shape[AXES.sp]
+    if k % n_sp != 0:
+        raise ValueError(f"sp={n_sp} must divide eval k={k}")
+    k_local = k // n_sp
+
+    def local_fn(params, key, x_local):
+        key = _fold_axis_coords(key)
+        log_w, aux = model.log_weights_and_aux(params, cfg, key, x_local, k_local)
+        vae = jnp.mean(lax.psum(jnp.sum(log_w, axis=0), AXES.sp) / k)
+        iwae = jnp.mean(distributed_logmeanexp(log_w, AXES.sp, k))
+        recon = jnp.mean(
+            lax.psum(jnp.sum(aux["log_px_given_h"], axis=0), AXES.sp) / k)
+        out = {
+            "VAE": vae,
+            "IWAE": iwae,
+            "E_q(h|x)[log(p(x|h))]": recon,
+            "D_kl(q(h|x),p(h))": recon - vae,
+        }
+        return {name: lax.pmean(v, AXES.dp) for name, v in out.items()}
+
+    return jax.jit(shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(), P(), P(AXES.dp)),
+        out_specs=P(),
+        check_vma=False,
+    ))
+
+
+@functools.lru_cache(maxsize=32)
+def make_parallel_reconstruction_loss(cfg: model.ModelConfig, mesh):
+    """Sharded 1-sample reconstruction BCE (cf. flexible_IWAE.py:249-262):
+    batch over dp; sp members compute identical shards (no k axis here)."""
+
+    def local_fn(params, key, x_local):
+        key = jax.random.fold_in(key, lax.axis_index(AXES.dp))
+        probs = model.reconstruct_probs(params, cfg, key, x_local)
+        lp = dist.bernoulli_log_prob(x_local[None], probs)
+        return lax.pmean(-jnp.mean(jnp.sum(lp, axis=-1)), AXES.dp)
+
+    return jax.jit(shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(), P(), P(AXES.dp)),
+        out_specs=P(),
+        check_vma=False,
+    ))
+
+
+@functools.lru_cache(maxsize=32)
+def make_parallel_posterior_means(cfg: model.ModelConfig, mesh,
+                                  n_samples: int, chunk: int = 10):
+    """MC posterior means E_q[h|x] with the sample count sharded over ALL
+    devices (the reference's 1000 eager passes, flexible_IWAE.py:270-273).
+
+    `x` is replicated (the activity suite needs cross-datapoint variances, so
+    every device sees the full set); each of the dp*sp devices contributes
+    ``n_samples / (dp*sp)`` samples via an on-device scan, then one psum.
+    Returns per-layer means ``[B, d_i]`` (replicated).
+    """
+    n_dev = mesh.shape[AXES.dp] * mesh.shape[AXES.sp]
+    if n_samples % n_dev != 0:
+        raise ValueError(f"activity n_samples={n_samples} must be divisible "
+                         f"by the device count {n_dev}")
+    n_local = n_samples // n_dev
+    chunk = largest_divisor_leq(n_local, chunk)
+
+    def local_fn(params, key, x):
+        key = _fold_axis_coords(key)
+
+        def body(sums, i):
+            h, _, _ = model.encode(params, cfg, jax.random.fold_in(key, i),
+                                   x, chunk)
+            return tuple(s + jnp.sum(hi, axis=0) for s, hi in zip(sums, h)), None
+
+        init = tuple(jnp.zeros((x.shape[0], d)) for d in cfg.n_latent_enc)
+        sums, _ = lax.scan(body, init, jnp.arange(n_local // chunk))
+        sums = jax.tree.map(
+            lambda s: lax.psum(lax.psum(s, AXES.sp), AXES.dp), sums)
+        return tuple(s / n_samples for s in sums)
+
+    return jax.jit(shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    ))
+
+
+@functools.lru_cache(maxsize=32)
+def make_parallel_pruned_nll(cfg: model.ModelConfig, mesh, k: int = 5000,
+                             chunk: int = 100, n_layers: int = 1):
+    """Masked-latent NLL (flexible_IWAE.py:466-494) with k sharded over sp;
+    the (small, first-batch) `x` is replicated."""
+    n_sp = mesh.shape[AXES.sp]
+    if k % n_sp != 0:
+        raise ValueError(f"sp={n_sp} must divide pruned-NLL k={k}")
+    k_local = k // n_sp
+    chunk = largest_divisor_leq(k_local, chunk)
+
+    def local_fn(params, key, x, *masks):
+        key = _fold_axis_coords(key)
+
+        def body(state, i):
+            lw = au._masked_log_weights(params, cfg, jax.random.fold_in(key, i),
+                                        x, masks, chunk)
+            return online_logsumexp_update(state, lw, axis=0), None
+
+        init = online_logsumexp_init((x.shape[0],))
+        state, _ = lax.scan(body, init, jnp.arange(k_local // chunk))
+        _, safe, s_g = _merge_lse_over_sp(state)
+        return -jnp.mean(jnp.log(s_g) + safe - jnp.log(float(k)))
+
+    in_specs = (P(), P(), P()) + (P(),) * n_layers
+    return jax.jit(shard_map(
+        local_fn, mesh=mesh,
+        in_specs=in_specs,
+        out_specs=P(),
+        check_vma=False,
+    ))
+
+
+def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
+                                 key: jax.Array, x_test: jax.Array, k: int,
+                                 batch_size: int = 100, nll_k: int = 5000,
+                                 nll_chunk: int = 100,
+                                 activity_samples: int = 1000,
+                                 activity_threshold: float = 0.01,
+                                 include_pruned_nll: bool = True
+                                 ) -> Tuple[Dict[str, float], Dict[str, object]]:
+    """Mesh-sharded drop-in for evaluation.metrics.training_statistics.
+
+    Same output schema (the reference's 7 scalars + LL_pruned and the
+    active-unit structures); the per-batch kernels run with the batch sharded
+    over dp and the sample axes over sp / all devices.
+    """
+    n_dp = mesh.shape[AXES.dp]
+    n_sp = mesh.shape[AXES.sp]
+    n = x_test.shape[0]
+    if n % n_dp != 0:
+        # dp needs equal batch shards; drop the ragged tail (≤ n_dp-1 points)
+        n_use = (n // n_dp) * n_dp
+        print(f"parallel eval: trimming test set {n} -> {n_use} "
+              f"for dp={n_dp} sharding")
+        x_test = x_test[:n_use]
+        n = n_use
+    # batches must split over dp; sample counts over sp / all devices
+    batch_size = max(d for d in range(1, min(batch_size, n) + 1)
+                     if n % d == 0 and d % n_dp == 0)
+    if k % n_sp != 0:
+        raise ValueError(f"eval k={k} must be divisible by sp={n_sp}")
+    if nll_k % n_sp != 0:
+        raise ValueError(f"nll_k={nll_k} must be divisible by sp={n_sp}")
+    n_dev = n_dp * n_sp
+    activity_samples = max(n_dev, (activity_samples // n_dev) * n_dev)
+
+    metrics_fn = make_parallel_batch_metrics(cfg, mesh, k)
+    log_px_fn = make_parallel_streaming_log_px(cfg, mesh, nll_k, nll_chunk)
+    recon_fn = make_parallel_reconstruction_loss(cfg, mesh)
+    means_fn = make_parallel_posterior_means(cfg, mesh, activity_samples)
+
+    n_batches = n // batch_size
+    batches = x_test.reshape(n_batches, batch_size, -1)
+    batch_sharding = NamedSharding(mesh, P(AXES.dp))
+
+    acc = {"VAE": 0.0, "IWAE": 0.0, "NLL": 0.0, "E_q(h|x)[log(p(x|h))]": 0.0,
+           "D_kl(q(h|x),p(h))": 0.0, "D_kl(q(h|x),p(h|x))": 0.0,
+           "reconstruction_loss": 0.0}
+    for i in range(n_batches):
+        bkey = jax.random.fold_in(key, i)
+        k1, k2, k3 = jax.random.split(bkey, 3)
+        xb = jax.device_put(batches[i], batch_sharding)
+        m = metrics_fn(params, k1, xb)
+        log_px = log_px_fn(params, k2, xb)
+        nll = -float(jnp.mean(log_px))
+        acc["VAE"] += float(m["VAE"]) / n_batches
+        acc["IWAE"] += float(m["IWAE"]) / n_batches
+        acc["NLL"] += nll / n_batches
+        acc["E_q(h|x)[log(p(x|h))]"] += float(m["E_q(h|x)[log(p(x|h))]"]) / n_batches
+        acc["D_kl(q(h|x),p(h))"] += float(m["D_kl(q(h|x),p(h))"]) / n_batches
+        acc["D_kl(q(h|x),p(h|x))"] += (-nll - float(m["VAE"])) / n_batches
+        acc["reconstruction_loss"] += float(recon_fn(params, k3, xb)) / n_batches
+
+    res2: Dict[str, object] = {}
+    k_au, k_pruned = jax.random.split(jax.random.fold_in(key, n_batches))
+    means = means_fn(params, k_au, jnp.asarray(x_test.reshape(n, -1)))
+    variances = tuple(jnp.var(m, axis=0) for m in means)
+    eigvals = tuple(au.pca_eigenvalues(m) for m in means)
+    masks, n_active, n_active_pca = au.active_units(variances, eigvals,
+                                                    threshold=activity_threshold)
+    res2["active_units"] = masks
+    res2["number_of_active_units"] = n_active
+    res2["number_of_PCA_active_units"] = n_active_pca
+    res2["variances"] = variances
+
+    if include_pruned_nll:
+        pruned_fn = make_parallel_pruned_nll(cfg, mesh, nll_k, nll_chunk,
+                                             n_layers=cfg.n_stochastic)
+        acc["LL_pruned"] = float(pruned_fn(params, k_pruned,
+                                           jnp.asarray(batches[0]), *masks))
+    return acc, res2

@@ -42,7 +42,10 @@ def make_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, n_train: int,
         raise ValueError(f"batch_size={batch_size} exceeds n_train={n_train}")
 
     def epoch(state: TrainState, x_train: jax.Array):
-        key, k_perm, k_bin = jax.random.split(state.key, 3)
+        # four independent streams: the carried key is never itself consumed
+        # by fold_in/permutation draws, preserving JAX's key-independence
+        # guarantee across epochs
+        key_next, k_batch, k_perm, k_bin = jax.random.split(state.key, 4)
         if shuffle:
             perm = jax.random.permutation(k_perm, n_train)
         else:
@@ -55,7 +58,7 @@ def make_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, n_train: int,
             if stochastic_binarization:
                 batch = jax.random.bernoulli(
                     jax.random.fold_in(k_bin, i), batch).astype(jnp.float32)
-            bkey = jax.random.fold_in(key, i)
+            bkey = jax.random.fold_in(k_batch, i)
             bound, grads = objective_value_and_grad(spec, st.params, cfg, bkey, batch)
             neg = jax.tree.map(jnp.negative, grads)
             updates, opt_state = opt.update(neg, st.opt_state, st.params)
@@ -63,6 +66,6 @@ def make_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, n_train: int,
             return TrainState(params, opt_state, st.key, st.step + 1), -bound
 
         state, losses = lax.scan(body, state, (idx, jnp.arange(n_batches)))
-        return state._replace(key=key), losses
+        return state._replace(key=key_next), losses
 
     return jax.jit(epoch, donate_argnums=(0,) if donate else ())

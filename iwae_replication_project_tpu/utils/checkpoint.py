@@ -19,6 +19,33 @@ import orbax.checkpoint as ocp
 from iwae_replication_project_tpu.training.train_step import TrainState
 
 
+def _config_identity(config_json: str) -> Optional[dict]:
+    """The science-field subset of a stored config JSON (output dirs and
+    execution knobs may legitimately differ between save and resume).
+
+    Parses the raw JSON dict rather than constructing an ExperimentConfig so
+    checkpoints written by older/newer config schemas still compare on the
+    fields they share. Returns None (treated as no-information, not mismatch)
+    for unparseable payloads."""
+    import dataclasses
+    import json
+
+    from iwae_replication_project_tpu.utils.config import (
+        SCIENCE_FIELDS,
+        ExperimentConfig,
+    )
+    try:
+        d = json.loads(config_json)
+    except json.JSONDecodeError:
+        return None
+    if not isinstance(d, dict):
+        return None
+    defaults = dataclasses.asdict(ExperimentConfig())
+    return {k: (list(v) if isinstance(v, (tuple, list)) else v)
+            for k in SCIENCE_FIELDS
+            for v in [d.get(k, defaults.get(k))]}
+
+
 def _manager(directory: str, keep: int = 3) -> ocp.CheckpointManager:
     return ocp.CheckpointManager(
         os.path.abspath(directory),
@@ -52,17 +79,34 @@ def latest_step(directory: str) -> Optional[int]:
     return step
 
 
-def restore_latest(directory: str, template: TrainState
+def restore_latest(directory: str, template: TrainState, *,
+                   expect_config_json: Optional[str] = None
                    ) -> Optional[Tuple[int, TrainState, int]]:
     """Restore ``(step, state, stage)`` from the newest checkpoint, or None.
 
     `template` supplies the pytree structure/dtypes (an identically-constructed
-    fresh TrainState).
+    fresh TrainState). When `expect_config_json` is given, the stored config is
+    compared against it and a mismatch raises instead of silently resuming a
+    *different* experiment's weights (run-dir collision protection).
     """
     step = latest_step(directory)
     if step is None:
         return None
     mgr = _manager(directory)
+    # meta first: the config-mismatch guard must fire BEFORE the state restore,
+    # where a different architecture would die inside Orbax with a cryptic
+    # pytree/shape error instead of the intended message
+    meta = mgr.restore(step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))["meta"]
+    stage = int(meta["stage"])
+    if expect_config_json:
+        stored_id = _config_identity(meta.get("config", ""))
+        expect_id = _config_identity(expect_config_json)
+        if stored_id is not None and expect_id is not None and stored_id != expect_id:
+            mgr.close()
+            raise ValueError(
+                f"checkpoint at {directory!r} was written by a different "
+                f"experiment config; refusing to resume.\n"
+                f"stored:  {stored_id}\ncurrent: {expect_id}")
     tmpl = {
         "params": template.params,
         "opt_state": template.opt_state,
@@ -71,11 +115,9 @@ def restore_latest(directory: str, template: TrainState
     }
     restored = mgr.restore(step, args=ocp.args.Composite(
         state=ocp.args.StandardRestore(tmpl),
-        meta=ocp.args.JsonRestore(),
     ))
     mgr.close()
     payload = restored["state"]
-    stage = int(restored["meta"]["stage"])
     state = TrainState(params=payload["params"], opt_state=payload["opt_state"],
                        key=payload["key"], step=payload["step"])
     return step, state, stage

@@ -16,6 +16,13 @@ from typing import Optional, Tuple
 from iwae_replication_project_tpu.models.iwae import ModelConfig
 from iwae_replication_project_tpu.objectives.estimators import ObjectiveSpec
 
+#: the config fields that define an experiment's identity (see science_fields)
+SCIENCE_FIELDS = (
+    "dataset", "n_hidden_encoder", "n_hidden_decoder",
+    "n_latent_encoder", "n_latent_decoder", "loss_function", "k", "p",
+    "alpha", "beta", "k2", "batch_size", "adam_eps",
+    "seed", "switch_stage", "switch_loss", "switch_k", "likelihood")
+
 
 @dataclasses.dataclass
 class ExperimentConfig:
@@ -63,14 +70,22 @@ class ExperimentConfig:
     mesh_sp: int = 1
     compute_dtype: Optional[str] = None  # None | "bfloat16"
     likelihood: str = "clamp"
+    # Pallas fused decoder-matmul+Bernoulli-LL kernel (ops/fused_likelihood).
+    # None = auto: enabled on TPU when likelihood == "logits".
+    fused_likelihood: Optional[bool] = None
 
     # observability / persistence
+    save_figures: bool = True  # per-stage sample/reconstruction PNG grids
     log_dir: str = "runs"
     checkpoint_dir: str = "checkpoints"
     checkpoint_keep: int = 3
     resume: bool = True
 
     def model_config(self) -> ModelConfig:
+        fused = self.fused_likelihood
+        if fused is None:
+            from iwae_replication_project_tpu.models.iwae import _on_tpu
+            fused = self.likelihood == "logits" and _on_tpu()
         return ModelConfig(
             n_hidden_enc=tuple(self.n_hidden_encoder),
             n_latent_enc=tuple(self.n_latent_encoder),
@@ -78,6 +93,7 @@ class ExperimentConfig:
             n_latent_dec=tuple(self.n_latent_decoder),
             likelihood=self.likelihood,
             compute_dtype=self.compute_dtype,
+            fused_likelihood=bool(fused),
         )
 
     def objective_spec(self, stage: Optional[int] = None) -> ObjectiveSpec:
@@ -90,9 +106,28 @@ class ExperimentConfig:
         return ObjectiveSpec(name=name, k=k, p=self.p, alpha=self.alpha,
                              beta=self.beta, k2=self.k2)
 
+    def science_fields(self) -> dict:
+        """The fields that define the *experiment identity* — everything that
+        changes what is being trained/measured, excluding output paths,
+        execution knobs (mesh/backend/dtype do not change the science), and
+        `n_stages` (extending the schedule and resuming is the intended
+        workflow)."""
+        return {f: getattr(self, f) for f in SCIENCE_FIELDS}
+
     def run_name(self) -> str:
-        """`IWAE-2L-k_50`-style tag (cf. experiment_example.py:67,95)."""
-        return f"{self.loss_function}-{len(self.n_hidden_encoder)}L-k_{self.k}"
+        """`IWAE-2L-k_50-binarized_mnist-s0-1a2b3c4d`-style tag.
+
+        Extends the reference's `{loss}-{L}L-k_{k}` naming
+        (experiment_example.py:67,95) with dataset, seed, and a hash of every
+        science field, so presets that differ only in alpha/beta/p/k2/switch_*
+        cannot collide in checkpoint_dir/log_dir (a collision plus resume=True
+        would silently restore the wrong experiment's weights)."""
+        import hashlib
+        ident = hashlib.sha1(
+            json.dumps(self.science_fields(), sort_keys=True, default=list)
+            .encode()).hexdigest()[:8]
+        return (f"{self.loss_function}-{len(self.n_hidden_encoder)}L-k_{self.k}"
+                f"-{self.dataset}-s{self.seed}-{ident}")
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2)
@@ -138,9 +173,15 @@ def build_argparser() -> argparse.ArgumentParser:
     ap.add_argument("--mesh-sp", dest="mesh_sp", default=None, type=int)
     ap.add_argument("--compute-dtype", dest="compute_dtype", default=None, type=str)
     ap.add_argument("--likelihood", default=None, type=str)
+    ap.add_argument("--fused-likelihood", dest="fused_likelihood",
+                    action="store_true", default=None)
+    ap.add_argument("--no-fused-likelihood", dest="fused_likelihood",
+                    action="store_false", default=None)
     ap.add_argument("--log-dir", dest="log_dir", default=None, type=str)
     ap.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None, type=str)
     ap.add_argument("--no-resume", dest="resume", action="store_false", default=None)
+    ap.add_argument("--no-figures", dest="save_figures", action="store_false",
+                    default=None)
     ap.add_argument("--hidden-encoder", dest="n_hidden_encoder", default=None, type=_int_list)
     ap.add_argument("--hidden-decoder", dest="n_hidden_decoder", default=None, type=_int_list)
     ap.add_argument("--latent-encoder", dest="n_latent_encoder", default=None, type=_int_list)

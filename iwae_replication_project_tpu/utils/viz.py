@@ -1,0 +1,82 @@
+"""Qualitative figures: sample and reconstruction grids as PNGs.
+
+The reference ships result images in its README (README.md:19-22) and the
+report's Figures 3-5 (reconstructions / generations, PDF pp.16-17). Here the
+same artifacts are written per evaluation stage from the model's
+`generate_x` / `reconstruct_probs` (flexible_IWAE.py:107-118, 249-254).
+
+PNG encoding goes through PIL (in the image alongside matplotlib); the grid
+assembly is plain numpy so there is no figure-backend dependency.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def image_grid(images: np.ndarray, ncols: int = 10,
+               img_hw: Tuple[int, int] = (28, 28), pad: int = 2) -> np.ndarray:
+    """Tile ``[N, H*W]`` probabilities in [0,1] into one uint8 grid image."""
+    images = np.asarray(images, dtype=np.float32)
+    n = images.shape[0]
+    h, w = img_hw
+    ncols = min(ncols, n)
+    nrows = (n + ncols - 1) // ncols
+    grid = np.ones((nrows * (h + pad) + pad, ncols * (w + pad) + pad),
+                   dtype=np.float32)
+    for i in range(n):
+        r, c = divmod(i, ncols)
+        top = pad + r * (h + pad)
+        left = pad + c * (w + pad)
+        grid[top:top + h, left:left + w] = images[i].reshape(h, w)
+    return (np.clip(grid, 0.0, 1.0) * 255).astype(np.uint8)
+
+
+def save_png(array_u8: np.ndarray, path: str) -> None:
+    from PIL import Image
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    Image.fromarray(array_u8, mode="L").save(path)
+
+
+def save_stage_figures(params, cfg, key: jax.Array, x_test: np.ndarray,
+                       out_dir: str, stage: int, n_samples: int = 100,
+                       n_recon: int = 20,
+                       img_hw: Optional[Tuple[int, int]] = None) -> list:
+    """Write `samples` (ancestral generations from the prior) and `recons`
+    (original/reconstruction pairs) grids for one evaluation stage.
+
+    Returns the written paths. Mirrors the reference's Figures 3-5 outputs:
+    generations via Decoder.generate_x from h_L ~ N(0, I), reconstructions
+    via the 1-sample encode/decode round trip.
+    """
+    from iwae_replication_project_tpu.models import iwae as model
+
+    if img_hw is None:
+        side = int(round(float(np.sqrt(cfg.x_dim))))
+        img_hw = (side, cfg.x_dim // side)
+    k_gen, k_rec = jax.random.split(key)
+
+    h_top = jax.random.normal(k_gen, (1, n_samples, cfg.n_latent_enc[-1]))
+    gen = np.asarray(model.generate_x(params, cfg, jax.random.fold_in(k_gen, 1),
+                                      h_top)[0])
+
+    x = jnp.asarray(x_test[:n_recon].reshape(n_recon, -1), jnp.float32)
+    rec = np.asarray(model.reconstruct_probs(params, cfg, k_rec, x)[0])
+    # interleave original / reconstruction column pairs
+    paired = np.empty((2 * n_recon, cfg.x_dim), dtype=np.float32)
+    paired[0::2] = np.asarray(x)
+    paired[1::2] = rec
+
+    fig_dir = os.path.join(out_dir, "figures")
+    paths = []
+    for name, arr, ncols in (("samples", gen, 10), ("recons", paired, 10)):
+        p = os.path.join(fig_dir, f"stage_{stage:02d}_{name}.png")
+        save_png(image_grid(arr, ncols=ncols, img_hw=img_hw), p)
+        paths.append(p)
+    return paths

@@ -85,6 +85,87 @@ class TestLoaders:
                          allow_synthetic=False)
 
 
+class TestRealFormatLoaders:
+    def test_amat_loading(self, tmp_path):
+        """Larochelle-format .amat text files (the reference's
+        binarized-MNIST source, README.md:10)."""
+        rs = np.random.RandomState(3)
+        xtr = (rs.rand(6, 784) > 0.5).astype(np.float32)
+        xte = (rs.rand(4, 784) > 0.5).astype(np.float32)
+        np.savetxt(tmp_path / "binarized_mnist_train.amat", xtr, fmt="%d")
+        np.savetxt(tmp_path / "binarized_mnist_test.amat", xte, fmt="%d")
+        ds = load_dataset("binarized_mnist", data_dir=str(tmp_path),
+                          allow_synthetic=False)
+        assert not ds.synthetic
+        np.testing.assert_array_equal(ds.x_train, xtr)
+        np.testing.assert_array_equal(ds.x_test, xte)
+        assert ds.binarization == "none"
+        # no raw MNIST present -> bias falls back to the binary train means
+        np.testing.assert_allclose(ds.bias_means, xtr.mean(0))
+
+    def test_amat_with_raw_mnist_bias_policy(self, tmp_path):
+        """With raw MNIST alongside, the fixed-bin bias must use the RAW
+        means (flexible_IWAE.py:150-155 policy)."""
+        rs = np.random.RandomState(4)
+        xtr = (rs.rand(6, 784) > 0.5).astype(np.float32)
+        xte = (rs.rand(4, 784) > 0.5).astype(np.float32)
+        np.savetxt(tmp_path / "binarized_mnist_train.amat", xtr, fmt="%d")
+        np.savetxt(tmp_path / "binarized_mnist_test.amat", xte, fmt="%d")
+        raw_train = rs.randint(0, 256, (5, 28, 28)).astype(np.uint8)
+        raw_test = rs.randint(0, 256, (2, 28, 28)).astype(np.uint8)
+        np.savez(tmp_path / "mnist.npz", x_train=raw_train, x_test=raw_test)
+        ds = load_dataset("binarized_mnist", data_dir=str(tmp_path),
+                          allow_synthetic=False)
+        np.testing.assert_allclose(
+            ds.bias_means,
+            (raw_train.reshape(-1, 784).astype(np.float32) / 255.0).mean(0),
+            rtol=1e-6)
+
+    def test_omniglot_chardata_mat(self, tmp_path):
+        """Burda-split Omniglot chardata.mat (flexible_IWAE.py:164-165):
+        columns are examples, `data`/`testdata` keys."""
+        import scipy.io as sio
+        rs = np.random.RandomState(5)
+        xtr = rs.rand(784, 7).astype(np.float32)
+        xte = rs.rand(784, 3).astype(np.float32)
+        sio.savemat(tmp_path / "chardata.mat", {"data": xtr, "testdata": xte})
+        ds = load_dataset("omniglot", data_dir=str(tmp_path),
+                          allow_synthetic=False)
+        assert not ds.synthetic
+        assert ds.x_train.shape == (7, 784)
+        assert ds.x_test.shape == (3, 784)
+        np.testing.assert_allclose(ds.x_train, xtr.T, rtol=1e-6)
+        assert ds.binarization == "stochastic"
+
+    def test_digits_is_real_offline_data(self, tmp_path):
+        """sklearn's bundled optdigits: real handwritten digits, fixed-bin
+        MNIST protocol (784-dim binary, deterministic, raw-means bias)."""
+        ds = load_dataset("digits", data_dir=str(tmp_path))
+        assert not ds.synthetic
+        assert ds.x_train.shape == (1500, 784)
+        assert ds.x_test.shape == (297, 784)
+        assert set(np.unique(ds.x_train)) <= {0.0, 1.0}
+        assert ds.binarization == "none"
+        # deterministic across loads (fixed binarization draw)
+        ds2 = load_dataset("digits", data_dir=str(tmp_path))
+        np.testing.assert_array_equal(ds.x_train, ds2.x_train)
+        # bias comes from raw grayscale means, not the binarized pixels
+        assert not np.allclose(ds.bias_means, ds.x_train.mean(0))
+
+    def test_synthetic_fallback_is_loud_and_flagged(self, tmp_path, capsys):
+        ds = load_dataset("mnist", data_dir=str(tmp_path), allow_synthetic=True)
+        assert ds.synthetic
+        out = capsys.readouterr()
+        assert "SYNTHETIC" in out.out
+        assert "SYNTHETIC" in out.err
+        # real data is never flagged
+        rs = np.random.RandomState(0)
+        np.savez(tmp_path / "mnist.npz",
+                 x_train=rs.randint(0, 256, (4, 28, 28)).astype(np.uint8),
+                 x_test=rs.randint(0, 256, (2, 28, 28)).astype(np.uint8))
+        assert not load_dataset("mnist", data_dir=str(tmp_path)).synthetic
+
+
 class TestBias:
     def test_formula(self):
         """bias = logit of clipped mean (flexible_IWAE.py:174)."""

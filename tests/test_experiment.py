@@ -39,7 +39,24 @@ class TestConfig:
         cfg = ExperimentConfig()
         assert cfg.model_config().n_stochastic == 2
         assert cfg.objective_spec().name == "IWAE"
-        assert cfg.run_name() == "IWAE-2L-k_50"
+        assert cfg.run_name().startswith("IWAE-2L-k_50-binarized_mnist-s0-")
+
+    def test_run_name_distinguishes_hyperparams(self):
+        """Presets differing only in alpha/beta/p/k2/seed/switch_* must not
+        collide in checkpoint_dir (ADVICE r1: collision + resume=True would
+        silently restore the wrong experiment's weights)."""
+        base = ExperimentConfig(loss_function="L_alpha")
+        names = {base.run_name(),
+                 ExperimentConfig(loss_function="L_alpha", alpha=0.25).run_name(),
+                 ExperimentConfig(loss_function="L_alpha", seed=1).run_name(),
+                 ExperimentConfig(loss_function="L_alpha", beta=0.05).run_name(),
+                 ExperimentConfig(loss_function="L_alpha", dataset="omniglot").run_name(),
+                 ExperimentConfig(loss_function="L_alpha",
+                                  switch_stage=5, switch_loss="VAE").run_name()}
+        assert len(names) == 6
+        # same science -> same name (resume must keep working)
+        assert base.run_name() == ExperimentConfig(
+            loss_function="L_alpha", log_dir="elsewhere").run_name()
 
     def test_cli_overrides(self, tmp_path):
         p = tmp_path / "c.json"
@@ -75,13 +92,36 @@ class TestRunExperiment:
         assert len(history2) == 1
         assert history2[0][0]["stage"] == 3
 
+    def test_mesh_run_uses_scanned_epochs(self, tmp_path):
+        """run_experiment on a (dp=4, sp=2) mesh trains via the whole-epoch
+        shard_map scan and produces finite staged metrics."""
+        cfg = tiny_config(tmp_path, mesh_dp=4, mesh_sp=2, k=4, batch_size=32,
+                          n_stages=2)
+        state, history = run_experiment(cfg, max_batches_per_pass=2,
+                                        eval_subset=32)
+        assert len(history) == 2
+        assert np.isfinite(history[-1][0]["NLL"])
+
     def test_jsonl_schema(self, tmp_path):
         cfg = tiny_config(tmp_path, n_stages=1)
         run_experiment(cfg, max_batches_per_pass=1, eval_subset=32)
         path = os.path.join(cfg.log_dir, cfg.run_name(), "metrics.jsonl")
         rec = json.loads(open(path).read().strip().splitlines()[-1])
-        for key in ("VAE", "IWAE", "NLL", "reconstruction_loss", "step"):
+        for key in ("VAE", "IWAE", "NLL", "reconstruction_loss", "step",
+                    "synthetic_data"):
             assert key in rec, key
+        assert bool(rec["synthetic_data"])  # tiny runs use blob fallback
+
+    def test_stage_figures_written(self, tmp_path):
+        cfg = tiny_config(tmp_path, n_stages=1)
+        run_experiment(cfg, max_batches_per_pass=1, eval_subset=32)
+        fig_dir = os.path.join(cfg.log_dir, cfg.run_name(), "figures")
+        assert os.path.exists(os.path.join(fig_dir, "stage_01_samples.png"))
+        assert os.path.exists(os.path.join(fig_dir, "stage_01_recons.png"))
+        # PNGs decode to the expected grid geometry
+        from PIL import Image
+        img = Image.open(os.path.join(fig_dir, "stage_01_samples.png"))
+        assert img.size[0] > 28 and img.size[1] > 28
 
 
 class TestBackendDispatch:

@@ -121,6 +121,20 @@ class TestModelBounds:
         with pytest.raises(ValueError):
             ObjectiveSpec("MIWAE", k=10, k2=3)
 
+    def test_vae_v1_rejects_multilayer_models(self):
+        """The reference marks get_L_V1 single-layer-only
+        (flexible_IWAE.py:433); a 2-layer model must raise, not silently
+        compute a wrong-by-construction 'analytic' bound."""
+        from iwae_replication_project_tpu.models import ModelConfig, iwae as model
+
+        cfg2 = ModelConfig(n_hidden_enc=(8, 8), n_latent_enc=(4, 2),
+                           n_hidden_dec=(8, 8), n_latent_dec=(4, 12), x_dim=12)
+        params = model.init_params(jax.random.PRNGKey(0), cfg2)
+        x = (jax.random.uniform(jax.random.PRNGKey(1), (6, 12)) > 0.5).astype(jnp.float32)
+        with pytest.raises(ValueError, match="single-stochastic-layer"):
+            objective_bound(ObjectiveSpec("VAE_V1", k=4), params, cfg2,
+                            jax.random.PRNGKey(2), x)
+
 
 class TestGradientEstimators:
     def test_standard_grad_matches_manual(self, model_setup):

@@ -125,6 +125,23 @@ class TestCheckpoint:
         template = create_train_state(rng, CFG)
         assert restore_latest(os.path.join(str(tmp_path), "nope"), template) is None
 
+    def test_refuses_resume_on_config_mismatch(self, rng, tmp_path):
+        """A checkpoint written by one experiment config must not silently
+        restore into a different one (ADVICE r1 medium)."""
+        from iwae_replication_project_tpu.utils.config import ExperimentConfig
+        d = os.path.join(str(tmp_path), "ckpt")
+        state = create_train_state(rng, CFG)
+        written = ExperimentConfig(loss_function="L_alpha", alpha=0.0)
+        save_checkpoint(d, 1, state, stage=2, config_json=written.to_json())
+        other = ExperimentConfig(loss_function="L_alpha", alpha=0.25)
+        with pytest.raises(ValueError, match="different"):
+            restore_latest(d, state, expect_config_json=other.to_json())
+        # matching science fields resume fine even if output dirs moved
+        moved = ExperimentConfig(loss_function="L_alpha", alpha=0.0,
+                                 log_dir="elsewhere")
+        assert restore_latest(d, state,
+                              expect_config_json=moved.to_json()) is not None
+
     def test_retention(self, rng, tmp_path):
         d = os.path.join(str(tmp_path), "ckpt")
         state = create_train_state(rng, CFG)
