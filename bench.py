@@ -44,6 +44,7 @@ BASELINE_ITERS = 50
 EVAL_BATCH = 100
 EVAL_K = 5000
 EVAL_CHUNK = 100
+EVAL_N = 10000    # full-test-set-sized fused eval (one dispatch)
 BASELINE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               ".bench_baseline.json")
 
@@ -72,28 +73,29 @@ def train_step_flops(batch: int, k: int) -> float:
     return 3.0 * fwd  # backward ~ 2x forward for dense stacks
 
 
-def peak_flops() -> float:
-    """Peak chip FLOP/s for the MFU denominator (override: BENCH_PEAK_FLOPS)."""
+def peak_flops():
+    """Peak chip FLOP/s for the MFU denominator (override: BENCH_PEAK_FLOPS).
+
+    Returns None when the platform's peak is unknown (non-TPU hosts) so `mfu`
+    is reported as null rather than a number with a fabricated denominator
+    (ADVICE r2)."""
     env = os.environ.get("BENCH_PEAK_FLOPS")
     if env:
         return float(env)
     import jax
     if any(d.platform == "tpu" for d in jax.devices()):
         return 197e12  # TPU v5e bf16 peak per chip
-    return 1e11  # nominal CPU figure so the field stays meaningful locally
+    return None
 
 
-def bench_jax():
+def _train_rates(cfg, reps=REPS):
     import jax
     import jax.numpy as jnp
 
-    from iwae_replication_project_tpu.models import ModelConfig
     from iwae_replication_project_tpu.objectives import ObjectiveSpec
     from iwae_replication_project_tpu.training import create_train_state
     from iwae_replication_project_tpu.training.epoch import make_epoch_fn
 
-    on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    cfg = ModelConfig.two_layer(likelihood="logits", fused_likelihood=on_tpu)
     spec = ObjectiveSpec("IWAE", k=K)
     state = create_train_state(jax.random.PRNGKey(0), cfg)
     epoch = make_epoch_fn(spec, cfg, N_TRAIN, BATCH, donate=False)
@@ -103,27 +105,45 @@ def bench_jax():
     np.asarray(losses)                # sync
     steps = EPOCHS * (N_TRAIN // BATCH)
     rates = []
-    for _ in range(REPS):
+    for _ in range(reps):
         t0 = time.perf_counter()
         for _ in range(EPOCHS):
             state, losses = epoch(state, x)
         np.asarray(losses)            # honest completion sync
         rates.append(steps / (time.perf_counter() - t0))
+    return rates, state
 
-    # eval path: k=5000 streaming NLL throughput (images/sec)
-    from iwae_replication_project_tpu.evaluation.metrics import streaming_log_px
-    xe = jnp.asarray(make_data(EVAL_BATCH))
+
+def bench_jax():
+    import jax
+    import jax.numpy as jnp
+
+    from iwae_replication_project_tpu.models import ModelConfig
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    cfg = ModelConfig.two_layer(likelihood="logits", fused_likelihood=on_tpu)
+    rates, state = _train_rates(cfg)
+    # secondary datapoint: bfloat16 matmul operands (f32 accumulation/params)
+    cfg_bf16 = ModelConfig.two_layer(likelihood="logits",
+                                     fused_likelihood=on_tpu,
+                                     compute_dtype="bfloat16")
+    rates_bf16, _ = _train_rates(cfg_bf16, reps=1)
+
+    # eval path: the full per-batch scalar suite (VAE/IWAE bounds at k=50,
+    # streaming k=5000 NLL, recon BCE) over EVAL_N images as ONE fused
+    # dispatch — evaluation.metrics.dataset_scalars, the same program
+    # run_experiment's per-stage eval uses.
+    from iwae_replication_project_tpu.evaluation.metrics import dataset_scalars
+    xe = jnp.asarray(make_data(EVAL_N)).reshape(EVAL_N // EVAL_BATCH,
+                                                EVAL_BATCH, 784)
     key = jax.random.PRNGKey(1)
-    np.asarray(streaming_log_px(state.params, cfg, key, xe,
-                                k=EVAL_K, chunk=EVAL_CHUNK))  # compile
+    np.asarray(dataset_scalars(state.params, cfg, key, xe, K,
+                               EVAL_K, EVAL_CHUNK))  # compile
     t0 = time.perf_counter()
-    n_eval_reps = 3
-    for i in range(n_eval_reps):
-        out = streaming_log_px(state.params, cfg, jax.random.fold_in(key, i),
-                               xe, k=EVAL_K, chunk=EVAL_CHUNK)
-    np.asarray(out)
-    eval_ips = n_eval_reps * EVAL_BATCH / (time.perf_counter() - t0)
-    return rates, eval_ips
+    np.asarray(dataset_scalars(state.params, cfg, key, xe, K,
+                               EVAL_K, EVAL_CHUNK))
+    eval_ips = EVAL_N / (time.perf_counter() - t0)
+    return rates, rates_bf16, eval_ips
 
 
 def bench_baseline() -> tuple:
@@ -157,10 +177,14 @@ def bench_baseline() -> tuple:
 
 
 def main():
-    rates, eval_ips = bench_jax()
+    rates, rates_bf16, eval_ips = bench_jax()
     base_sps, base_n = bench_baseline()
     mean_sps = float(np.mean(rates))
-    mfu = mean_sps * train_step_flops(BATCH, K) / peak_flops()
+    bf16_sps = float(np.mean(rates_bf16))
+    peak = peak_flops()
+    step_flops = train_step_flops(BATCH, K)
+    mfu = round(mean_sps * step_flops / peak, 6) if peak else None
+    mfu_bf16 = round(bf16_sps * step_flops / peak, 6) if peak else None
     print(json.dumps({
         "metric": "IWAE-k50-2L train throughput (batch 100, whole-epoch scan)",
         "value": round(mean_sps, 2),
@@ -168,9 +192,13 @@ def main():
         "vs_baseline": round(mean_sps / base_sps, 2),
         "spread": {"min": round(min(rates), 2), "max": round(max(rates), 2),
                    "n_reps": len(rates)},
+        "steps_per_sec_bf16": round(bf16_sps, 2),
         "eval_images_per_sec": round(eval_ips, 2),
-        "eval_config": {"k": EVAL_K, "chunk": EVAL_CHUNK, "batch": EVAL_BATCH},
-        "mfu": round(mfu, 6),
+        "eval_config": {"k": EVAL_K, "chunk": EVAL_CHUNK, "batch": EVAL_BATCH,
+                        "n_images": EVAL_N,
+                        "suite": "full per-batch scalar suite (fused)"},
+        "mfu": mfu,
+        "mfu_bf16": mfu_bf16,
         "baseline_steps_per_sec": round(base_sps, 3),
         "baseline_steps": base_n,
     }))

@@ -95,14 +95,19 @@ def _masked_log_weights(params, cfg: model.ModelConfig, key: jax.Array,
             + model.log_px_given_h(params, cfg, x, h[0]) - log_q)
 
 
+@partial(jax.jit, static_argnames=("cfg", "k", "chunk"))
 def nll_without_inactive_units(params, cfg: model.ModelConfig, key: jax.Array,
                                x: jax.Array, masks, k: int = 5000,
                                chunk: int = 100) -> jax.Array:
     """-L_k with pruned latents — the 'cost of pruning' diagnostic (PDF §4.2.1),
-    streamed in k-chunks like the unpruned NLL."""
-    state = online_logsumexp_init((x.shape[0],))
-    for i in range(k // chunk):
+    streamed in k-chunks like the unpruned NLL. One XLA program (a `lax.scan`
+    over chunks) rather than a host loop of per-chunk dispatches; the per-chunk
+    RNG folds are unchanged."""
+    def body(state, i):
         lw = _masked_log_weights(params, cfg, jax.random.fold_in(key, i), x,
                                  masks, chunk)
-        state = online_logsumexp_update(state, lw, axis=0)
+        return online_logsumexp_update(state, lw, axis=0), None
+
+    init = online_logsumexp_init((x.shape[0],))
+    state, _ = lax.scan(body, init, jnp.arange(k // chunk))
     return -jnp.mean(online_logsumexp_finalize(state, mean=True))

@@ -102,6 +102,22 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
             _, state, start_stage = restored
             start_stage += 1
             print(f"resumed from checkpoint; continuing at stage {start_stage}")
+        else:
+            # run_name() embeds a hash of the science fields, so checkpoints
+            # written under an older naming scheme (or an edited config) are
+            # invisible to resume. Surface near-miss directories loudly rather
+            # than silently restarting from scratch (ADVICE r2).
+            prefix = f"{cfg.loss_function}-{len(cfg.n_hidden_encoder)}L-k_{cfg.k}-"
+            if os.path.isdir(cfg.checkpoint_dir):
+                stale = [d for d in os.listdir(cfg.checkpoint_dir)
+                         if d.startswith(prefix) and d != cfg.run_name()]
+                if stale:
+                    shown = ", ".join(stale[:3]) + (", ..." if len(stale) > 3
+                                                    else "")
+                    print(f"note: no checkpoint under {ckpt_dir}, but "
+                          f"{len(stale)} same-prefix run dir(s) exist "
+                          f"({shown}): they belong to a different config "
+                          f"hash / naming scheme and will NOT be resumed")
 
     logger = MetricsLogger(cfg.log_dir, run_name=cfg.run_name())
     eval_key = jax.random.PRNGKey(cfg.seed + 10_000)

@@ -32,7 +32,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from iwae_replication_project_tpu.evaluation import activity as au
-from iwae_replication_project_tpu.evaluation.metrics import largest_divisor_leq
+from iwae_replication_project_tpu.evaluation.metrics import (
+    SCALAR_NAMES,
+    largest_divisor_leq,
+)
 from iwae_replication_project_tpu.models import iwae as model
 from iwae_replication_project_tpu.ops import distributions as dist
 from iwae_replication_project_tpu.ops.logsumexp import (
@@ -54,6 +57,59 @@ def _merge_lse_over_sp(state):
     return m_g, safe, s_g
 
 
+# --- shared per-device bodies -------------------------------------------------
+# One source of truth for the local math: the standalone per-batch factories
+# below AND the fused whole-dataset scan both call these, so the two eval
+# paths cannot drift apart. Every body expects `key` already folded per
+# (dp, sp) coordinate via _fold_axis_coords, except _local_recon_loss which
+# folds dp itself (its sp members intentionally compute identical values).
+
+def _local_streaming_log_px(params, cfg, key, x_local, k_local: int,
+                            chunk: int, k_global: int):
+    """``[B_local]`` log p̂(x): scan k_local/chunk fresh-sample blocks through
+    the online-logsumexp carry, then merge carries across sp."""
+    def body(state, i):
+        lw = model.log_weights(params, cfg, jax.random.fold_in(key, i),
+                               x_local, chunk)
+        return online_logsumexp_update(state, lw, axis=0), None
+
+    init = online_logsumexp_init((x_local.shape[0],))
+    state, _ = lax.scan(body, init, jnp.arange(k_local // chunk))
+    _, safe, s_g = _merge_lse_over_sp(state)
+    return jnp.log(s_g) + safe - jnp.log(float(k_global))
+
+
+def _local_batch_metrics(params, cfg, key, x_local, k_local: int,
+                         k_global: int):
+    """Single-pass metric bundle on the local shard; scalars are means over
+    the local batch shard (callers pmean over dp)."""
+    log_w, aux = model.log_weights_and_aux(params, cfg, key, x_local, k_local)
+    vae = jnp.mean(lax.psum(jnp.sum(log_w, axis=0), AXES.sp) / k_global)
+    iwae = jnp.mean(distributed_logmeanexp(log_w, AXES.sp, k_global))
+    recon = jnp.mean(
+        lax.psum(jnp.sum(aux["log_px_given_h"], axis=0), AXES.sp) / k_global)
+    return {
+        "VAE": vae,
+        "IWAE": iwae,
+        "E_q(h|x)[log(p(x|h))]": recon,
+        "D_kl(q(h|x),p(h))": recon - vae,
+    }
+
+
+def _local_recon_loss(params, cfg, key, x_local):
+    """dp-local 1-sample reconstruction BCE (flexible_IWAE.py:249-262)."""
+    key = jax.random.fold_in(key, lax.axis_index(AXES.dp))
+    probs = model.reconstruct_probs(params, cfg, key, x_local)
+    lp = dist.bernoulli_log_prob(x_local[None], probs)
+    return -jnp.mean(jnp.sum(lp, axis=-1))
+
+
+def _validate_eval_k(name: str, k: int, n_sp: int) -> int:
+    if k % n_sp != 0:
+        raise ValueError(f"sp={n_sp} must divide {name}={k}")
+    return k // n_sp
+
+
 @functools.lru_cache(maxsize=32)
 def make_parallel_streaming_log_px(cfg: model.ModelConfig, mesh, k: int = 5000,
                                    chunk: int = 100):
@@ -64,24 +120,12 @@ def make_parallel_streaming_log_px(cfg: model.ModelConfig, mesh, k: int = 5000,
     across sp at the end. Per-device RNG folds (chunk index, dp, sp) so all
     ``k`` global samples are independent.
     """
-    n_sp = mesh.shape[AXES.sp]
-    if k % n_sp != 0:
-        raise ValueError(f"sp={n_sp} must divide eval k={k}")
-    k_local = k // n_sp
+    k_local = _validate_eval_k("eval k", k, mesh.shape[AXES.sp])
     chunk = largest_divisor_leq(k_local, chunk)
 
     def local_fn(params, key, x_local):
-        key = _fold_axis_coords(key)
-
-        def body(state, i):
-            lw = model.log_weights(params, cfg, jax.random.fold_in(key, i),
-                                   x_local, chunk)
-            return online_logsumexp_update(state, lw, axis=0), None
-
-        init = online_logsumexp_init((x_local.shape[0],))
-        state, _ = lax.scan(body, init, jnp.arange(k_local // chunk))
-        _, safe, s_g = _merge_lse_over_sp(state)
-        return jnp.log(s_g) + safe - jnp.log(float(k))
+        return _local_streaming_log_px(params, cfg, _fold_axis_coords(key),
+                                       x_local, k_local, chunk, k)
 
     return jax.jit(shard_map(
         local_fn, mesh=mesh,
@@ -95,24 +139,11 @@ def make_parallel_streaming_log_px(cfg: model.ModelConfig, mesh, k: int = 5000,
 def make_parallel_batch_metrics(cfg: model.ModelConfig, mesh, k: int):
     """Sharded single-pass metric bundle (cf. evaluation.metrics.batch_metrics):
     batch over dp, the k fan-out over sp, scalars replicated."""
-    n_sp = mesh.shape[AXES.sp]
-    if k % n_sp != 0:
-        raise ValueError(f"sp={n_sp} must divide eval k={k}")
-    k_local = k // n_sp
+    k_local = _validate_eval_k("eval k", k, mesh.shape[AXES.sp])
 
     def local_fn(params, key, x_local):
-        key = _fold_axis_coords(key)
-        log_w, aux = model.log_weights_and_aux(params, cfg, key, x_local, k_local)
-        vae = jnp.mean(lax.psum(jnp.sum(log_w, axis=0), AXES.sp) / k)
-        iwae = jnp.mean(distributed_logmeanexp(log_w, AXES.sp, k))
-        recon = jnp.mean(
-            lax.psum(jnp.sum(aux["log_px_given_h"], axis=0), AXES.sp) / k)
-        out = {
-            "VAE": vae,
-            "IWAE": iwae,
-            "E_q(h|x)[log(p(x|h))]": recon,
-            "D_kl(q(h|x),p(h))": recon - vae,
-        }
+        out = _local_batch_metrics(params, cfg, _fold_axis_coords(key),
+                                   x_local, k_local, k)
         return {name: lax.pmean(v, AXES.dp) for name, v in out.items()}
 
     return jax.jit(shard_map(
@@ -129,10 +160,7 @@ def make_parallel_reconstruction_loss(cfg: model.ModelConfig, mesh):
     batch over dp; sp members compute identical shards (no k axis here)."""
 
     def local_fn(params, key, x_local):
-        key = jax.random.fold_in(key, lax.axis_index(AXES.dp))
-        probs = model.reconstruct_probs(params, cfg, key, x_local)
-        lp = dist.bernoulli_log_prob(x_local[None], probs)
-        return lax.pmean(-jnp.mean(jnp.sum(lp, axis=-1)), AXES.dp)
+        return lax.pmean(_local_recon_loss(params, cfg, key, x_local), AXES.dp)
 
     return jax.jit(shard_map(
         local_fn, mesh=mesh,
@@ -215,6 +243,55 @@ def make_parallel_pruned_nll(cfg: model.ModelConfig, mesh, k: int = 5000,
     ))
 
 
+@functools.lru_cache(maxsize=32)
+def make_parallel_dataset_scalars(cfg: model.ModelConfig, mesh, k: int,
+                                  nll_k: int, nll_chunk: int):
+    """``(params, key, batches[n_batches, B, d]) -> 7-vector`` — the whole
+    test-set scalar suite as ONE sharded XLA program.
+
+    A `lax.scan` over batches wraps the same local computations as
+    :func:`make_parallel_batch_metrics` / :func:`make_parallel_streaming_log_px`
+    / :func:`make_parallel_reconstruction_loss`, with identical per-batch RNG
+    folding — so the result matches the per-batch host loop to accumulation
+    rounding, at one dispatch instead of ~3 per batch (each dispatch through a
+    remote-device transport costs ~10-15 ms; see RESULTS.md). Batches shard
+    over dp on their *second* axis; sample axes shard over sp. Output order is
+    evaluation.metrics.SCALAR_NAMES.
+    """
+    n_sp = mesh.shape[AXES.sp]
+    k_local = _validate_eval_k("eval k", k, n_sp)
+    nll_k_local = _validate_eval_k("nll_k", nll_k, n_sp)
+    nll_chunk = largest_divisor_leq(nll_k_local, nll_chunk)
+
+    def local_fn(params, key, batches_local):
+        def per_batch(carry, inp):
+            i, xb = inp
+            bkey = jax.random.fold_in(key, i)
+            k1, k2, k3 = jax.random.split(bkey, 3)
+            m = _local_batch_metrics(params, cfg, _fold_axis_coords(k1), xb,
+                                     k_local, k)
+            nll = -jnp.mean(_local_streaming_log_px(
+                params, cfg, _fold_axis_coords(k2), xb,
+                nll_k_local, nll_chunk, nll_k))
+            rl = _local_recon_loss(params, cfg, k3, xb)
+            vals = jnp.stack([m["VAE"], m["IWAE"], nll,
+                              m["E_q(h|x)[log(p(x|h))]"],
+                              m["D_kl(q(h|x),p(h))"], -nll - m["VAE"], rl])
+            return carry + lax.pmean(vals, AXES.dp), None
+
+        n_batches = batches_local.shape[0]
+        tot, _ = lax.scan(per_batch, jnp.zeros(len(SCALAR_NAMES)),
+                          (jnp.arange(n_batches), batches_local))
+        return tot / n_batches
+
+    return jax.jit(shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(), P(), P(None, AXES.dp)),
+        out_specs=P(),
+        check_vma=False,
+    ))
+
+
 def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
                                  key: jax.Array, x_test: jax.Array, k: int,
                                  batch_size: int = 100, nll_k: int = 5000,
@@ -226,8 +303,9 @@ def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
     """Mesh-sharded drop-in for evaluation.metrics.training_statistics.
 
     Same output schema (the reference's 7 scalars + LL_pruned and the
-    active-unit structures); the per-batch kernels run with the batch sharded
-    over dp and the sample axes over sp / all devices.
+    active-unit structures). The whole scalar suite runs as one fused
+    batch-scan program (batch over dp, sample axes over sp); activity and the
+    pruned NLL are one dispatch each.
     """
     n_dp = mesh.shape[AXES.dp]
     n_sp = mesh.shape[AXES.sp]
@@ -239,8 +317,10 @@ def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
               f"for dp={n_dp} sharding")
         x_test = x_test[:n_use]
         n = n_use
-    # batches must split over dp; sample counts over sp / all devices
-    batch_size = max(d for d in range(1, min(batch_size, n) + 1)
+    # batches must split over dp; after the trim n % n_dp == 0, so d = n_dp
+    # always qualifies — the search floor keeps batch_size >= n_dp even when
+    # the requested batch_size is smaller (ADVICE r2: empty-max crash).
+    batch_size = max(d for d in range(1, min(max(batch_size, n_dp), n) + 1)
                      if n % d == 0 and d % n_dp == 0)
     if k % n_sp != 0:
         raise ValueError(f"eval k={k} must be divisible by sp={n_sp}")
@@ -249,32 +329,15 @@ def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
     n_dev = n_dp * n_sp
     activity_samples = max(n_dev, (activity_samples // n_dev) * n_dev)
 
-    metrics_fn = make_parallel_batch_metrics(cfg, mesh, k)
-    log_px_fn = make_parallel_streaming_log_px(cfg, mesh, nll_k, nll_chunk)
-    recon_fn = make_parallel_reconstruction_loss(cfg, mesh)
+    scalars_fn = make_parallel_dataset_scalars(cfg, mesh, k, nll_k, nll_chunk)
     means_fn = make_parallel_posterior_means(cfg, mesh, activity_samples)
 
     n_batches = n // batch_size
     batches = x_test.reshape(n_batches, batch_size, -1)
-    batch_sharding = NamedSharding(mesh, P(AXES.dp))
+    batches = jax.device_put(batches, NamedSharding(mesh, P(None, AXES.dp)))
 
-    acc = {"VAE": 0.0, "IWAE": 0.0, "NLL": 0.0, "E_q(h|x)[log(p(x|h))]": 0.0,
-           "D_kl(q(h|x),p(h))": 0.0, "D_kl(q(h|x),p(h|x))": 0.0,
-           "reconstruction_loss": 0.0}
-    for i in range(n_batches):
-        bkey = jax.random.fold_in(key, i)
-        k1, k2, k3 = jax.random.split(bkey, 3)
-        xb = jax.device_put(batches[i], batch_sharding)
-        m = metrics_fn(params, k1, xb)
-        log_px = log_px_fn(params, k2, xb)
-        nll = -float(jnp.mean(log_px))
-        acc["VAE"] += float(m["VAE"]) / n_batches
-        acc["IWAE"] += float(m["IWAE"]) / n_batches
-        acc["NLL"] += nll / n_batches
-        acc["E_q(h|x)[log(p(x|h))]"] += float(m["E_q(h|x)[log(p(x|h))]"]) / n_batches
-        acc["D_kl(q(h|x),p(h))"] += float(m["D_kl(q(h|x),p(h))"]) / n_batches
-        acc["D_kl(q(h|x),p(h|x))"] += (-nll - float(m["VAE"])) / n_batches
-        acc["reconstruction_loss"] += float(recon_fn(params, k3, xb)) / n_batches
+    scalars = np.asarray(scalars_fn(params, key, batches))
+    acc = {name: float(v) for name, v in zip(SCALAR_NAMES, scalars)}
 
     res2: Dict[str, object] = {}
     k_au, k_pruned = jax.random.split(jax.random.fold_in(key, n_batches))

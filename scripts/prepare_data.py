@@ -56,6 +56,10 @@ def main(argv=None):
         except FileNotFoundError:
             print(f"  {name:16s} MISSING (loaders would fall back to synthetic "
                   f"blobs; see module docstring for expected files)")
+        except ImportError as e:
+            # "digits" imports scikit-learn at load time; a missing dependency
+            # should mark one dataset unavailable, not crash the whole report
+            print(f"  {name:16s} UNAVAILABLE (import failed: {e})")
 
     if ns.export_digits:
         import numpy as np

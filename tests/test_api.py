@@ -33,6 +33,7 @@ class TestDispatch:
         from iwae_replication_project_tpu.backends.torch_ref import TorchFlexibleModel
         assert isinstance(build("torch"), TorchFlexibleModel)
 
+    @pytest.mark.slow
     def test_tf2_backend_gated(self):
         with pytest.raises((ImportError, NotImplementedError)):
             build("tf2")
@@ -144,6 +145,7 @@ class TestCrossBackendParity:
         for jval, tval in pairs:
             np.testing.assert_allclose(float(jval), float(tval), rtol=1e-5)
 
+    @pytest.mark.slow
     def test_model_parity_weight_tied(self):
         """THE load-bearing cross-backend check: copy the JAX params into the
         torch oracle, then both backends' bounds are MC estimates of the SAME
@@ -173,6 +175,7 @@ class TestCrossBackendParity:
         assert abs(jn.mean() - tn.mean()) < max(4 * se, 0.02), (
             jn.mean(), tn.mean(), se)
 
+    @pytest.mark.slow
     def test_torch_eval_surface_parity_weight_tied(self):
         """The newly-completed torch eval surface (activity, pruned NLL,
         reconstruction, generation, statistics driver) agrees with the JAX
@@ -201,14 +204,27 @@ class TestCrossBackendParity:
         assert tm.generate(5).shape == (5, x.shape[1])
         assert np.asarray(jm.generate(5)).shape == (5, x.shape[1])
 
-        jres, jres2 = jm.get_training_statistics(x, 4, batch_size=16, nll_k=64,
-                                                 nll_chunk=16,
-                                                 activity_samples=128)
-        tres, tres2 = tm.get_training_statistics(x, 4, batch_size=16, nll_k=64,
-                                                 nll_chunk=16,
-                                                 activity_samples=128)
+        # statistics driver: repeated MC estimates per backend, SE-scaled
+        # corridor (same form as test_model_parity_weight_tied — a
+        # tenths-of-a-nat systematic torch/JAX bias in the replication-target
+        # metrics must fail, VERDICT r2 weak #6)
+        n_rep = 4
+        jreps, treps = [], []
+        for _ in range(n_rep):
+            jres, jres2 = jm.get_training_statistics(x, 4, batch_size=16,
+                                                     nll_k=64, nll_chunk=16,
+                                                     activity_samples=128)
+            tres, tres2 = tm.get_training_statistics(x, 4, batch_size=16,
+                                                     nll_k=64, nll_chunk=16,
+                                                     activity_samples=128)
+            jreps.append(jres)
+            treps.append(tres)
         assert set(jres) == set(tres)
         for key in ("VAE", "IWAE", "NLL"):
-            assert abs(jres[key] - tres[key]) < 1.0, (key, jres[key], tres[key])
+            jv = np.array([r[key] for r in jreps])
+            tv = np.array([r[key] for r in treps])
+            se = np.sqrt(jv.var(ddof=1) / n_rep + tv.var(ddof=1) / n_rep)
+            assert abs(jv.mean() - tv.mean()) < max(4 * se, 0.02), (
+                key, jv.mean(), tv.mean(), se)
         assert (jres2["number_of_active_units"]
                 == tres2["number_of_active_units"])

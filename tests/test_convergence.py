@@ -1,0 +1,115 @@
+"""CI-sized convergence evidence on REAL data (VERDICT r2 missing #1c).
+
+The `digits` dataset (sklearn-bundled UCI optdigits, prepared to mirror the
+fixed-binarization protocol — data/loaders.py) is the one real image dataset
+available offline, so these tests are the suite's ground-truth check that the
+full staged pipeline *learns* on real data: NLL must fall below a recorded
+threshold, must improve across stages, and IWAE must not be worse than VAE
+(Burda Table 1 ordering). Full-length runs live in RESULTS.md; these are the
+short-schedule proxies (SURVEY.md §7 hard part (e))."""
+
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu.experiment import run_experiment
+from iwae_replication_project_tpu.utils.config import ExperimentConfig
+
+pytestmark = [pytest.mark.filterwarnings("ignore::DeprecationWarning"),
+              pytest.mark.slow]
+
+
+def digits_config(tmp_path, **over):
+    d = dict(
+        dataset="digits", allow_synthetic=False,
+        n_hidden_encoder=(64,), n_hidden_decoder=(64,),
+        n_latent_encoder=(16,), n_latent_decoder=(784,),
+        loss_function="IWAE", k=5, batch_size=100, n_stages=3,
+        eval_k=5, nll_k=128, nll_chunk=64, eval_batch_size=99,
+        activity_samples=64, save_figures=False, resume=False,
+        log_dir=str(tmp_path / "runs"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    d.update(over)
+    return ExperimentConfig(**d)
+
+
+def final_nll(history):
+    return history[-1][0]["NLL"]
+
+
+class TestDigitsConvergence:
+    def test_iwae_converges_and_beats_vae(self, tmp_path):
+        """3 Burda stages (13 passes) on real digits: NLL improves stage over
+        stage, lands below a recorded threshold, and the trained-IWAE NLL is
+        not worse than trained-VAE (the qualitative Table 1 ordering)."""
+        _, hist_iwae = run_experiment(digits_config(tmp_path))
+        nlls = [res["NLL"] for res, _ in hist_iwae]
+        assert all(res["synthetic_data"] is False for res, _ in hist_iwae)
+        # learning happened: NLL falls monotonically across stages and lands
+        # below the recorded threshold. Calibration (CPU + TPU, seeds 0/1):
+        # stage trajectories ~[373-375, 329-335, 305-316]; the binarized
+        # upsampled digits have a high Bernoulli entropy floor, so the
+        # absolute scale is ~300, not MNIST's ~90.
+        assert all(b < a for a, b in zip(nlls, nlls[1:])), nlls
+        assert nlls[-1] < 330.0, nlls
+
+        _, hist_vae = run_experiment(
+            digits_config(tmp_path, loss_function="VAE"))
+        # same schedule, same seed: IWAE's tighter bound must not train a
+        # worse model. Calibrated gap is ~1-10 nats in IWAE's favour; the
+        # +2 corridor absorbs MC noise of the k=128 NLL estimate without
+        # letting a real ordering inversion pass.
+        assert final_nll(hist_iwae) <= final_nll(hist_vae) + 2.0, (
+            final_nll(hist_iwae), final_nll(hist_vae))
+
+
+class TestLikelihoodNeutrality:
+    def test_likelihood_modes_nll_neutral(self, tmp_path):
+        """Train the same config under likelihood="clamp" (reference
+        bit-parity: sigmoid + prob clamp, flexible_IWAE.py:102) and
+        "logits" (exact x*l - softplus(l), the fast default) — the trained
+        models' NLLs must agree within an SE-scaled corridor. This is what
+        licenses defaulting ExperimentConfig.likelihood to the fast path
+        (VERDICT r2 missing #3)."""
+        import jax
+        import jax.numpy as jnp
+        from iwae_replication_project_tpu.data import load_dataset
+        from iwae_replication_project_tpu.evaluation.metrics import (
+            streaming_log_px)
+
+        states, cfgs = {}, {}
+        for mode in ("clamp", "logits"):
+            cfg = digits_config(tmp_path, likelihood=mode, n_stages=2)
+            state, _ = run_experiment(cfg)
+            states[mode] = state
+            cfgs[mode] = cfg.model_config()
+
+        ds = load_dataset("digits", allow_synthetic=False)
+        x = jnp.asarray(ds.x_test.reshape(len(ds.x_test), -1))
+        key = jax.random.PRNGKey(7)
+        # per-example log px under each trained model, SAME eval samples
+        lp = {mode: np.asarray(streaming_log_px(
+                  states[mode].params, cfgs[mode], key, x, k=256, chunk=64))
+              for mode in ("clamp", "logits")}
+        diff = lp["clamp"] - lp["logits"]
+        se = diff.std(ddof=1) / np.sqrt(len(diff))
+        assert abs(diff.mean()) < max(4 * se, 0.05), (
+            diff.mean(), se, lp["clamp"].mean(), lp["logits"].mean())
+
+    def test_likelihood_modes_same_params_tight(self):
+        """On IDENTICAL params the two likelihood modes are the same function
+        up to the 1e-6 prob clamp: per-example log px agrees to < 5e-3."""
+        import jax
+        import jax.numpy as jnp
+        from iwae_replication_project_tpu.models import iwae as model
+
+        cfg_c = model.ModelConfig.one_layer(likelihood="clamp")
+        cfg_l = model.ModelConfig.one_layer(likelihood="logits")
+        params = model.init_params(jax.random.PRNGKey(0), cfg_c)
+        x = jnp.asarray((np.random.RandomState(0).rand(32, 784) > 0.5)
+                        .astype(np.float32))
+        key = jax.random.PRNGKey(1)
+        lw_c = model.log_weights(params, cfg_c, key, x, 16)
+        lw_l = model.log_weights(params, cfg_l, key, x, 16)
+        np.testing.assert_allclose(np.asarray(lw_c), np.asarray(lw_l),
+                                   atol=5e-3)

@@ -49,6 +49,7 @@ class TestEpochFn:
         state, l2 = epoch(state, x_train)
         assert not np.allclose(np.asarray(l1), np.asarray(l2))
 
+    @pytest.mark.slow
     def test_stochastic_binarization_on_device(self, rng):
         # gray 0.5 inputs: with on-device binarization the model sees binary
         # pixels, so losses differ from the no-binarization run

@@ -73,6 +73,7 @@ class TestBatchMetrics:
 
 
 class TestActiveUnits:
+    @pytest.mark.slow
     def test_activity_shapes(self, rng):
         params = init_params(rng, CFG2)
         x = (jax.random.uniform(jax.random.PRNGKey(1), (20, 12)) > 0.5).astype(jnp.float32)

@@ -74,6 +74,7 @@ class TestConfig:
 
 
 class TestRunExperiment:
+    @pytest.mark.slow
     def test_tiny_run_and_resume(self, tmp_path):
         cfg = tiny_config(tmp_path)
         state, history = run_experiment(cfg, max_batches_per_pass=2, eval_subset=32)
@@ -92,6 +93,7 @@ class TestRunExperiment:
         assert len(history2) == 1
         assert history2[0][0]["stage"] == 3
 
+    @pytest.mark.slow
     def test_mesh_run_uses_scanned_epochs(self, tmp_path):
         """run_experiment on a (dp=4, sp=2) mesh trains via the whole-epoch
         shard_map scan and produces finite staged metrics."""
@@ -142,6 +144,7 @@ class TestBackendDispatch:
 
 
 class TestGraftEntry:
+    @pytest.mark.slow
     def test_entry_compiles(self):
         import jax
         sys.path.insert(0, "/root/repo")
@@ -150,6 +153,7 @@ class TestGraftEntry:
         val = jax.jit(fn)(*args)
         assert np.isfinite(float(val))
 
+    @pytest.mark.slow
     def test_dryrun_multichip_8(self, devices):
         sys.path.insert(0, "/root/repo")
         from __graft_entry__ import dryrun_multichip

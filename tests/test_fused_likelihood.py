@@ -111,6 +111,7 @@ class TestModelIntegration:
                         n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12,
                         fused_likelihood=True)
 
+    @pytest.mark.slow
     def test_fused_training_grads_finite(self, rng):
         from iwae_replication_project_tpu.objectives import (
             ObjectiveSpec, objective_value_and_grad)

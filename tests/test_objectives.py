@@ -89,6 +89,7 @@ class TestModelBounds:
         v1 = objective_bound(ObjectiveSpec("VAE_V1", k=k), params, CFG, key, x)
         np.testing.assert_allclose(float(mc), float(v1), atol=0.05)
 
+    @pytest.mark.slow
     def test_iwae_monotone_in_k(self, model_setup):
         """E[L_{k}] nondecreasing in k (Burda Thm 1; PDF p.5 Eq. 3)."""
         params, x = model_setup
@@ -199,6 +200,7 @@ class TestGradientEstimators:
         v_iwae, _ = objective_value_and_grad(ObjectiveSpec("IWAE", k=6), params, CFG, key, x)
         np.testing.assert_allclose(float(v_stl), float(v_iwae), rtol=1e-6)
 
+    @pytest.mark.slow
     def test_multilayer_gradients_finite(self, rng):
         params = init_params(rng, CFG2)
         x = (jax.random.uniform(jax.random.PRNGKey(1), (4, 12)) > 0.5).astype(jnp.float32)

@@ -138,6 +138,7 @@ class TestDataParallel:
 
     @pytest.mark.parametrize("dp,sp", [(8, 1), (4, 2), (2, 4)])
     @pytest.mark.parametrize("name", ["IWAE", "VAE"])
+    @pytest.mark.slow
     def test_sharded_value_and_grad_matches_single_device(self, devices, rng,
                                                           dp, sp, name):
         """The load-bearing equivalence (SURVEY §4): loss AND per-leaf grads of
@@ -166,6 +167,7 @@ class TestDataParallel:
 
     @pytest.mark.parametrize("dp,sp", [(8, 1), (4, 2), (2, 4)])
     @pytest.mark.parametrize("name", ["DReG", "STL", "PIWAE"])
+    @pytest.mark.slow
     def test_gradient_estimators_match_single_device(self, devices, rng,
                                                      dp, sp, name):
         """The modified-gradient estimators under dp AND sp sharding: the
@@ -222,6 +224,7 @@ class TestDataParallel:
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6),
             grads_m, grads_r)
 
+    @pytest.mark.slow
     def test_parallel_train_step_params_match_manual_update(self, devices, rng):
         """One full mesh train step == reference grads + the same optax update
         applied on a single device (catches key-threading drift between the
@@ -272,6 +275,7 @@ class TestDataParallel:
 
 
 class TestParallelEpoch:
+    @pytest.mark.slow
     def test_mesh_epoch_matches_manual_steps(self, devices, rng):
         """The whole-epoch scan under the mesh == manual per-batch reference
         (matched RNG, same Adam updates) after a 2-batch epoch."""
@@ -373,6 +377,7 @@ class TestSampleParallel:
         _, metrics = step(state, batch)
         assert np.isfinite(float(metrics["loss"]))
 
+    @pytest.mark.slow
     def test_sp_train_step_runs_all_estimators(self, devices, rng):
         """Every objective trains under sp>1 (SP_SHARDABLE has no exclusions)."""
         mesh = make_mesh(dp=2, sp=2)

@@ -31,6 +31,7 @@ def _fold(key, i_dp, i_sp):
     return jax.random.fold_in(jax.random.fold_in(key, i_dp), i_sp)
 
 
+@pytest.mark.slow
 class TestShardedStreamingNLL:
     @pytest.mark.parametrize("dp,sp", [(4, 2), (2, 4), (1, 8)])
     def test_matches_matched_rng_reference(self, devices, rng, dp, sp):
@@ -118,6 +119,7 @@ class TestShardedActivity:
                                        rtol=0.5, atol=0.05)
 
 
+@pytest.mark.slow
 class TestParallelStatistics:
     def test_full_suite_schema_and_consistency(self, devices, rng):
         """The sharded statistics driver returns the reference schema, with
