@@ -1,0 +1,103 @@
+"""Render results/nll_trajectories.png from the committed run artifacts.
+
+Design notes (deliberate, not cosmetic): two panels (1L / 2L) with one y-axis
+each; hue encodes the objective family (VAE blue, IWAE orange — validated
+categorical slots), linestyle encodes k (dashed low, solid high) so identity
+is never color-alone; series are direct-labeled at the line ends plus a
+legend; grid/axes stay recessive; the best (stage-6) point is dot-marked.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SURFACE = "#fcfcfb"
+INK = "#0b0b0b"
+MUTED = "#898781"
+GRID = "#e1e0d9"
+BASELINE = "#c3c2b7"
+BLUE = "#2a78d6"    # categorical slot 1 -> VAE
+ORANGE = "#eb6834"  # categorical slot 2 -> IWAE
+
+SERIES = [  # (loss, k, color, linestyle)
+    ("VAE", 1, BLUE, (0, (4, 2))),
+    ("VAE", 50, BLUE, "solid"),
+    ("IWAE", 5, ORANGE, (0, (4, 2))),
+    ("IWAE", 50, ORANGE, "solid"),
+]
+
+
+def trajectory(run_name: str):
+    """NLL by stage, first record per stage (resumed/extended runs append)."""
+    path = os.path.join("results/runs", run_name, "metrics.jsonl")
+    by_stage = {}
+    for line in open(path):
+        rec = json.loads(line)
+        by_stage.setdefault(rec["stage"], rec["NLL"])
+    return [by_stage[s] for s in sorted(by_stage)]
+
+
+def main():
+    rows = {(r["layers"], r["loss"], r["k"]): r
+            for r in json.load(open("results/summary.json"))
+            if r["dataset"] == "digits"}
+    fig, axes = plt.subplots(1, 2, figsize=(9.6, 3.8), sharey=True,
+                             facecolor=SURFACE)
+    for ax, layers in zip(axes, (1, 2)):
+        ax.set_facecolor(SURFACE)
+        ends = []
+        for loss, k, color, ls in SERIES:
+            r = rows[(layers, loss, k)]
+            nll = trajectory(r["run_name"])
+            stages = range(1, len(nll) + 1)
+            ax.plot(stages, nll, color=color, linestyle=ls, linewidth=2)
+            best = min(range(len(nll)), key=lambda i: nll[i])
+            ax.plot(best + 1, nll[best], "o", color=color, markersize=5,
+                    markeredgecolor=SURFACE, markeredgewidth=1.2)
+            ends.append((nll[-1], len(nll), f"{loss} k={k}"))
+        # direct labels at the line ends, nudged apart so they never collide
+        ends.sort()
+        label_y = []
+        for y, _, _ in ends:
+            if label_y and y - label_y[-1] < 9.0:
+                y = label_y[-1] + 9.0
+            label_y.append(y)
+        for (y_end, x_end, text), y_lab in zip(ends, label_y):
+            ax.annotate(text, (x_end, y_end), xytext=(x_end + 0.15, y_lab),
+                        fontsize=8, color=INK, va="center")
+        ax.set_title(f"{layers} stochastic layer{'s' if layers > 1 else ''}",
+                     fontsize=10, color=INK)
+        ax.set_xlabel("Burda stage", fontsize=9, color=MUTED)
+        ax.set_xlim(0.8, 9.6)
+        ax.grid(True, color=GRID, linewidth=0.6)
+        ax.tick_params(colors=MUTED, labelsize=8)
+        for side in ("top", "right"):
+            ax.spines[side].set_visible(False)
+        for side in ("left", "bottom"):
+            ax.spines[side].set_color(BASELINE)
+    axes[0].set_ylabel("test NLL  (−log p̂, k=5000)", fontsize=9, color=MUTED)
+    handles = [plt.Line2D([], [], color=c, linestyle=ls, linewidth=2,
+                          label=f"{loss} k={k}")
+               for loss, k, c, ls in SERIES]
+    fig.legend(handles=handles, loc="upper center", ncol=4, frameon=False,
+               fontsize=8, bbox_to_anchor=(0.5, 1.02))
+    fig.suptitle("digits (real data): NLL by stage — dot marks the best stage"
+                 " (overfitting begins at stage 7 of the 3280-pass schedule)",
+                 fontsize=9, color=MUTED, y=1.1)
+    fig.tight_layout()
+    out = "results/nll_trajectories.png"
+    fig.savefig(out, dpi=160, bbox_inches="tight", facecolor=SURFACE)
+    print("wrote", out)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,30 +57,102 @@ def replication_suite(n_stages: int = 8):
     return runs
 
 
+def seed_study(seeds=(1, 2), n_stages: int = 8):
+    """Replicate the headline ordering comparison (VAE k=1 vs IWAE k=50, both
+    depths) across extra seeds, for the error bars in RESULTS.md §2 (seed 0
+    is covered by the main suite)."""
+    runs = []
+    for seed in seeds:
+        for arch_name, arch in (("1L", ARCH_1L), ("2L", ARCH_2L)):
+            for loss, k in (("VAE", 1), ("IWAE", 50)):
+                runs.append((f"digits-{arch_name}-{loss}-k{k}-s{seed}",
+                             ExperimentConfig(
+                                 dataset="digits", allow_synthetic=False,
+                                 loss_function=loss, k=k, seed=seed,
+                                 n_stages=n_stages, eval_batch_size=99,
+                                 save_figures=False, log_dir=RESULTS_DIR,
+                                 checkpoint_dir="checkpoints", **arch)))
+    return runs
+
+
+def torch_cross_check(n_stages: int = 5):
+    """Train the same digits config on the independent eager torch oracle and
+    on the JAX path; report both NLL trajectories (cross-backend scientific
+    validation on REAL data; summary in results/torch_cross_check.json)."""
+    # own log/ckpt dirs: nll_k/eval knobs are not science fields, so this
+    # config's run_name collides with the main suite's digits-1L-IWAE-k5 run —
+    # logging into RESULTS_DIR would append to that committed artifact
+    base = dict(dataset="digits", allow_synthetic=False, loss_function="IWAE",
+                k=5, n_stages=n_stages, eval_batch_size=99, nll_k=500,
+                save_figures=False, resume=False,
+                log_dir="results/cross_check",
+                checkpoint_dir="checkpoints/cross_check", **ARCH_1L)
+    out = {}
+    for backend in ("jax", "torch"):
+        cfg = ExperimentConfig(backend=backend, **base)
+        t0 = time.perf_counter()
+        _, history = run_experiment(cfg)
+        out[backend] = {
+            "NLL_by_stage": [round(r["NLL"], 3) for r, _ in history],
+            "IWAE_by_stage": [round(r["IWAE"], 3) for r, _ in history],
+            "active_units": history[-1][1]["number_of_active_units"],
+            "wall_seconds": round(time.perf_counter() - t0, 1),
+        }
+        print(f"{backend}: NLL {out[backend]['NLL_by_stage']} "
+              f"in {out[backend]['wall_seconds']}s")
+    out["final_nll_gap"] = round(out["jax"]["NLL_by_stage"][-1]
+                                 - out["torch"]["NLL_by_stage"][-1], 3)
+    os.makedirs("results", exist_ok=True)
+    with open("results/torch_cross_check.json", "w") as f:
+        json.dump(out, f, indent=2)
+    print("wrote results/torch_cross_check.json; final NLL gap "
+          f"{out['final_nll_gap']} nats")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
                     help="3 stages instead of 8 (smoke)")
     ap.add_argument("--only", default=None,
                     help="substring filter on run names")
+    ap.add_argument("--seed-study", action="store_true",
+                    help="run the extra-seed ordering study instead of the "
+                         "main suite (summary lands in "
+                         "results/summary_seeds.json)")
+    ap.add_argument("--torch-check", action="store_true",
+                    help="run the torch-oracle cross-backend check on digits")
     ns = ap.parse_args(argv)
+    if ns.torch_check:
+        torch_cross_check()
+        return
 
     n_stages = 3 if ns.quick else 8
+    suite = (seed_study(n_stages=n_stages) if ns.seed_study
+             else replication_suite(n_stages))
     summary = []
-    for name, cfg in replication_suite(n_stages):
+    for name, cfg in suite:
         if ns.only and ns.only not in name:
             continue
         print(f"\n=== {name} ({n_stages} stages, run {cfg.run_name()}) ===")
         t0 = time.perf_counter()
         _, history = run_experiment(cfg)
         dt = time.perf_counter() - t0
+        if not history:
+            print(f"--- {name}: already complete (resumed past final stage); "
+                  f"keeping existing summary row")
+            continue
         res, res2 = history[-1]
+        nlls = [r["NLL"] for r, _ in history]
+        best = min(range(len(nlls)), key=lambda i: nlls[i])
         summary.append({
             "name": name, "run_name": cfg.run_name(),
             "dataset": cfg.dataset, "loss": cfg.loss_function, "k": cfg.k,
+            "seed": cfg.seed,
             "layers": len(cfg.n_hidden_encoder), "stages": n_stages,
             "synthetic_data": res["synthetic_data"],
             "NLL": round(res["NLL"], 3),
+            "best_NLL": round(nlls[best], 3),
+            "best_stage": best + 1,
             "IWAE_bound": round(res["IWAE"], 3),
             "VAE_bound": round(res["VAE"], 3),
             "active_units": res2["number_of_active_units"],
@@ -91,7 +163,14 @@ def main(argv=None):
               f"active={res2['number_of_active_units']} in {dt:.0f}s")
 
     os.makedirs("results", exist_ok=True)
-    out = os.path.join("results", "summary.json")
+    out = os.path.join("results", "summary_seeds.json" if ns.seed_study
+                       else "summary.json")
+    if os.path.exists(out):
+        # merge by run name so a filtered (--only) rerun refreshes its rows
+        # without discarding the rest of the committed summary
+        old = {r["name"]: r for r in json.load(open(out))}
+        old.update({r["name"]: r for r in summary})
+        summary = list(old.values())
     with open(out, "w") as f:
         json.dump(summary, f, indent=2)
     print(f"\nwrote {out}")

@@ -151,3 +151,28 @@ class TestParallelStatistics:
             batch_size=8, nll_k=16, nll_chunk=8, activity_samples=64,
             include_pruned_nll=False)
         assert np.isfinite(res["NLL"])
+
+    def test_small_eval_batch_floors_to_dp(self, devices, rng):
+        """eval batch_size < dp must floor to dp, not crash with an empty
+        max() (ADVICE r2)."""
+        mesh = make_mesh(dp=8, sp=1)
+        params = create_train_state(rng, CFG).params
+        res, _ = parallel_training_statistics(
+            params, CFG, mesh, jax.random.PRNGKey(6), make_x(32), k=8,
+            batch_size=4, nll_k=16, nll_chunk=8, activity_samples=64,
+            include_pruned_nll=False)
+        assert np.isfinite(res["NLL"])
+
+    def test_fused_scalars_rejects_undivisible_k(self, devices):
+        """The fused whole-dataset factory enforces the same sp-divisibility
+        guards as its per-batch siblings (silent truncation would bias every
+        scalar)."""
+        from iwae_replication_project_tpu.parallel.eval import (
+            make_parallel_dataset_scalars)
+        mesh = make_mesh(dp=4, sp=2)
+        with pytest.raises(ValueError, match="must divide"):
+            make_parallel_dataset_scalars(CFG, mesh, k=7, nll_k=16,
+                                          nll_chunk=8)
+        with pytest.raises(ValueError, match="must divide"):
+            make_parallel_dataset_scalars(CFG, mesh, k=8, nll_k=17,
+                                          nll_chunk=8)
