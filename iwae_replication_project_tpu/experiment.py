@@ -32,6 +32,11 @@ from iwae_replication_project_tpu.utils.checkpoint import restore_latest, save_c
 from iwae_replication_project_tpu.utils.config import ExperimentConfig
 from iwae_replication_project_tpu.utils.logging import MetricsLogger
 
+#: passes fused into one dispatch for the long Burda stages; 27 = 3^3 divides
+#: every stage length >= 27 of the 3^(i-1) schedule, so stages 4-8 run
+#: entirely in blocks and only stages 1-3 (1+3+9 passes) dispatch per pass
+PASS_BLOCK = 27
+
 
 def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = None,
                    eval_subset: Optional[int] = None):
@@ -72,25 +77,31 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     # train functions are built per active objective (objective switching,
     # PDF Table 10, changes the spec mid-run) and cached. Either way a data
     # pass is ONE compiled dispatch (whole-epoch lax.scan — training/epoch.py
-    # single-device, parallel/dp.py under the mesh).
+    # single-device, parallel/dp.py under the mesh), and the long late stages
+    # batch PASS_BLOCK passes per dispatch: at small-dataset scale a pass is
+    # ~5 ms of device work vs ~10-15 ms of per-dispatch transport, so stage 8
+    # (3^7 = 2187 passes) would otherwise spend ~30 s on dispatch alone.
     _fn_cache = {}
 
-    def epoch_fn_for(active_spec):
-        if active_spec in _fn_cache:
-            return _fn_cache[active_spec]
+    def epoch_fn_for(active_spec, epochs_per_call=1):
+        cache_key = (active_spec, epochs_per_call)
+        if cache_key in _fn_cache:
+            return _fn_cache[cache_key]
         if mesh is not None:
             from iwae_replication_project_tpu.parallel.dp import make_parallel_epoch_fn
             fn = make_parallel_epoch_fn(
                 active_spec, model_cfg, mesh, n_train, cfg.batch_size,
                 stochastic_binarization=ds.binarization == "stochastic",
-                optimizer=opt, donate=False)
+                optimizer=opt, donate=False,
+                epochs_per_call=epochs_per_call)
         else:
             from iwae_replication_project_tpu.training.epoch import make_epoch_fn
             fn = make_epoch_fn(
                 active_spec, model_cfg, n_train, cfg.batch_size,
                 stochastic_binarization=ds.binarization == "stochastic",
-                optimizer=opt, donate=False)
-        _fn_cache[active_spec] = fn
+                optimizer=opt, donate=False,
+                epochs_per_call=epochs_per_call)
+        _fn_cache[cache_key] = fn
         return fn
 
     ckpt_dir = os.path.join(cfg.checkpoint_dir, cfg.run_name())
@@ -129,10 +140,16 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
             continue
         state = set_learning_rate(state, lr)
         active_spec = cfg.objective_spec(stage)
-        epoch_fn = epoch_fn_for(active_spec)
         print(f"stage {stage}: lr={lr:.2e}, {passes} passes, "
               f"objective {active_spec.name} k={active_spec.k}")
-        for p in range(passes):
+        remaining = passes
+        if passes >= PASS_BLOCK and max_batches_per_pass is None:
+            block_fn = epoch_fn_for(active_spec, PASS_BLOCK)
+            for _ in range(passes // PASS_BLOCK):
+                state, _ = block_fn(state, x_train_dev)
+            remaining = passes % PASS_BLOCK
+        epoch_fn = epoch_fn_for(active_spec)
+        for p in range(remaining):
             state, _ = epoch_fn(state, x_train_dev)
 
         if mesh is not None:

@@ -267,7 +267,8 @@ def make_parallel_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
                            n_train: int, batch_size: int,
                            stochastic_binarization: bool = False,
                            optimizer: optax.GradientTransformation | None = None,
-                           shuffle: bool = True, donate: bool = True):
+                           shuffle: bool = True, donate: bool = True,
+                           epochs_per_call: int = 1):
     """Whole-epoch training under the mesh: ONE dispatch per data pass.
 
     The single-device path already runs each epoch as one `lax.scan`
@@ -283,6 +284,8 @@ def make_parallel_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
     sample see the same binarized pixels, exactly like the host pipeline.
 
     Returns ``epoch(state, x_train_replicated) -> (state, per-batch losses)``.
+    ``epochs_per_call > 1`` scans that many consecutive epochs inside the one
+    dispatch (losses concatenated), exactly like training/epoch.py.
     """
     opt = optimizer if optimizer is not None else make_adam()
     n_sp, k_local = _validate_sharding(spec, mesh, batch_size)
@@ -290,6 +293,8 @@ def make_parallel_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
     n_batches = n_train // batch_size
     if n_batches == 0:
         raise ValueError(f"batch_size={batch_size} exceeds n_train={n_train}")
+    if epochs_per_call < 1:
+        raise ValueError(f"epochs_per_call={epochs_per_call} must be >= 1")
     b_local = batch_size // n_dp
     vg = _make_local_value_and_grad(spec, cfg, n_sp, k_local)
 
@@ -319,8 +324,17 @@ def make_parallel_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
         state, losses = lax.scan(body, state, (idx, jnp.arange(n_batches)))
         return state._replace(key=key_next), losses
 
+    if epochs_per_call == 1:
+        local_fn = epoch_local
+    else:
+        def local_fn(state, x_train):
+            state, losses = lax.scan(
+                lambda st, _: epoch_local(st, x_train), state,
+                None, length=epochs_per_call)
+            return state, losses.reshape(-1)
+
     sharded = shard_map(
-        epoch_local, mesh=mesh,
+        local_fn, mesh=mesh,
         in_specs=(P(), P()),
         out_specs=(P(), P()),
         check_vma=False,

@@ -29,17 +29,27 @@ from iwae_replication_project_tpu.training.train_step import TrainState, make_ad
 def make_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, n_train: int,
                   batch_size: int, stochastic_binarization: bool = False,
                   optimizer: optax.GradientTransformation | None = None,
-                  shuffle: bool = True, donate: bool = True
+                  shuffle: bool = True, donate: bool = True,
+                  epochs_per_call: int = 1
                   ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, jax.Array]]:
     """Build ``epoch(state, x_train) -> (state, per-batch losses)``, jitted.
 
     `x_train` is the full ``[n_train, x_dim]`` set (placed on device once by
     the caller); drop-remainder batching like the host pipeline.
+
+    With ``epochs_per_call > 1`` the returned function runs that many
+    consecutive epochs inside one dispatch (an outer `lax.scan`; losses from
+    all epochs concatenated). Each dispatch through a remote-device transport
+    costs ~10-15 ms, so at small-dataset scale (e.g. digits: ~5 ms of device
+    work per pass) per-pass dispatch dominates the stage loop — the
+    experiment driver batches the long late stages with this knob.
     """
     opt = optimizer if optimizer is not None else make_adam()
     n_batches = n_train // batch_size
     if n_batches == 0:
         raise ValueError(f"batch_size={batch_size} exceeds n_train={n_train}")
+    if epochs_per_call < 1:
+        raise ValueError(f"epochs_per_call={epochs_per_call} must be >= 1")
 
     def epoch(state: TrainState, x_train: jax.Array):
         # four independent streams: the carried key is never itself consumed
@@ -68,4 +78,12 @@ def make_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, n_train: int,
         state, losses = lax.scan(body, state, (idx, jnp.arange(n_batches)))
         return state._replace(key=key_next), losses
 
-    return jax.jit(epoch, donate_argnums=(0,) if donate else ())
+    if epochs_per_call == 1:
+        return jax.jit(epoch, donate_argnums=(0,) if donate else ())
+
+    def multi(state: TrainState, x_train: jax.Array):
+        state, losses = lax.scan(lambda st, _: epoch(st, x_train), state,
+                                 None, length=epochs_per_call)
+        return state, losses.reshape(-1)
+
+    return jax.jit(multi, donate_argnums=(0,) if donate else ())

@@ -11,14 +11,14 @@ from __future__ import annotations
 
 import json
 import os
-import sys
 
 import matplotlib
 
 matplotlib.use("Agg")
 import matplotlib.pyplot as plt  # noqa: E402
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# paths are relative to the repo root: run as `python scripts/plot_results.py`
+# from /root/repo (reads results/summary.json + results/runs/*/metrics.jsonl)
 
 SURFACE = "#fcfcfb"
 INK = "#0b0b0b"

@@ -144,6 +144,8 @@ def main(argv=None):
         res, res2 = history[-1]
         nlls = [r["NLL"] for r, _ in history]
         best = min(range(len(nlls)), key=lambda i: nlls[i])
+        best_stage = int(history[best][0]["stage"])  # not best+1: a resumed
+        # run's history may start past stage 1
         summary.append({
             "name": name, "run_name": cfg.run_name(),
             "dataset": cfg.dataset, "loss": cfg.loss_function, "k": cfg.k,
@@ -152,7 +154,7 @@ def main(argv=None):
             "synthetic_data": res["synthetic_data"],
             "NLL": round(res["NLL"], 3),
             "best_NLL": round(nlls[best], 3),
-            "best_stage": best + 1,
+            "best_stage": best_stage,
             "IWAE_bound": round(res["IWAE"], 3),
             "VAE_bound": round(res["VAE"], 3),
             "active_units": res2["number_of_active_units"],
@@ -163,8 +165,12 @@ def main(argv=None):
               f"active={res2['number_of_active_units']} in {dt:.0f}s")
 
     os.makedirs("results", exist_ok=True)
-    out = os.path.join("results", "summary_seeds.json" if ns.seed_study
-                       else "summary.json")
+    if ns.quick:
+        # smoke runs must never replace committed 8-stage rows in place
+        out = os.path.join("results", "summary_quick.json")
+    else:
+        out = os.path.join("results", "summary_seeds.json" if ns.seed_study
+                           else "summary.json")
     if os.path.exists(out):
         # merge by run name so a filtered (--only) rerun refreshes its rows
         # without discarding the rest of the committed summary

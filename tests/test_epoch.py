@@ -32,6 +32,28 @@ class TestEpochFn:
         assert float(jnp.mean(losses)) < first
         assert int(state.step) == 60
 
+    def test_multi_epoch_call_matches_repeated_single(self, rng, x_train):
+        """epochs_per_call=3 must reproduce 3 single-epoch dispatches exactly
+        (same key threading, same update sequence) with concatenated losses."""
+        spec = ObjectiveSpec("IWAE", k=4)
+        single = make_epoch_fn(spec, CFG, 64, 16, donate=False)
+        multi = make_epoch_fn(spec, CFG, 64, 16, donate=False,
+                              epochs_per_call=3)
+        s_single = create_train_state(rng, CFG)
+        all_losses = []
+        for _ in range(3):
+            s_single, losses = single(s_single, x_train)
+            all_losses.append(np.asarray(losses))
+        s_multi, losses_multi = multi(create_train_state(rng, CFG), x_train)
+        assert losses_multi.shape == (12,)
+        np.testing.assert_allclose(np.asarray(losses_multi),
+                                   np.concatenate(all_losses), rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            s_single.params, s_multi.params)
+        assert int(s_multi.step) == int(s_single.step) == 12
+
     def test_deterministic_given_state(self, rng, x_train):
         s0 = create_train_state(rng, CFG)
         epoch = make_epoch_fn(ObjectiveSpec("VAE", k=4), CFG, 64, 16, donate=False)

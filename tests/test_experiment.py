@@ -104,6 +104,26 @@ class TestRunExperiment:
         assert len(history) == 2
         assert np.isfinite(history[-1][0]["NLL"])
 
+    @pytest.mark.slow
+    def test_pass_block_path_matches_single_dispatch(self, tmp_path, monkeypatch):
+        """The stage loop's fused-pass path (PASS_BLOCK epochs per dispatch)
+        must produce the same staged metrics as per-pass dispatching."""
+        import iwae_replication_project_tpu.experiment as exp
+
+        cfg = tiny_config(tmp_path, n_stages=3, resume=False,
+                          save_figures=False)
+        monkeypatch.setattr(exp, "PASS_BLOCK", 3)
+        _, hist_block = run_experiment(cfg, eval_subset=32)
+
+        monkeypatch.setattr(exp, "PASS_BLOCK", 10**9)  # block never triggers
+        cfg2 = tiny_config(tmp_path, n_stages=3, resume=False,
+                           save_figures=False,
+                           log_dir=str(tmp_path / "runs2"),
+                           checkpoint_dir=str(tmp_path / "ckpt2"))
+        _, hist_single = run_experiment(cfg2, eval_subset=32)
+        for (ra, _), (rb, _) in zip(hist_block, hist_single):
+            assert abs(ra["NLL"] - rb["NLL"]) < 1e-3, (ra["NLL"], rb["NLL"])
+
     def test_jsonl_schema(self, tmp_path):
         cfg = tiny_config(tmp_path, n_stages=1)
         run_experiment(cfg, max_batches_per_pass=1, eval_subset=32)

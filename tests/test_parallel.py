@@ -276,6 +276,36 @@ class TestDataParallel:
 
 class TestParallelEpoch:
     @pytest.mark.slow
+    def test_mesh_multi_epoch_matches_repeated_single(self, devices, rng):
+        """epochs_per_call under the mesh == repeated single-epoch dispatches
+        (same key threading), with concatenated per-batch losses."""
+        from iwae_replication_project_tpu.parallel import make_parallel_epoch_fn
+
+        mesh = make_mesh(dp=4, sp=2)
+        spec = ObjectiveSpec("IWAE", k=8)
+        state0 = create_train_state(rng, CFG2)
+        x_train = make_batch(32)
+
+        single = make_parallel_epoch_fn(spec, CFG2, mesh, n_train=32,
+                                        batch_size=16, donate=False)
+        multi = make_parallel_epoch_fn(spec, CFG2, mesh, n_train=32,
+                                       batch_size=16, donate=False,
+                                       epochs_per_call=2)
+        s1 = replicate(mesh, state0)
+        ls = []
+        for _ in range(2):
+            s1, losses = single(s1, replicate(mesh, x_train))
+            ls.append(np.asarray(losses))
+        s2, losses2 = multi(replicate(mesh, state0), replicate(mesh, x_train))
+        assert losses2.shape == (4,)
+        np.testing.assert_allclose(np.asarray(losses2), np.concatenate(ls),
+                                   rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            s1.params, s2.params)
+
+    @pytest.mark.slow
     def test_mesh_epoch_matches_manual_steps(self, devices, rng):
         """The whole-epoch scan under the mesh == manual per-batch reference
         (matched RNG, same Adam updates) after a 2-batch epoch."""
