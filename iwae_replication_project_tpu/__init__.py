@@ -18,4 +18,13 @@ __version__ = "0.1.0"
 from iwae_replication_project_tpu.models import iwae as models  # noqa: F401
 from iwae_replication_project_tpu import objectives  # noqa: F401
 
-__all__ = ["models", "objectives", "__version__"]
+__all__ = ["models", "objectives", "FlexibleModel", "__version__"]
+
+
+def __getattr__(name):
+    # lazy: the facade pulls in backend modules, which plain library users
+    # (models/objectives only) should not pay for at import time
+    if name == "FlexibleModel":
+        from iwae_replication_project_tpu.api import FlexibleModel
+        return FlexibleModel
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

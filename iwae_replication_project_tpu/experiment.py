@@ -130,7 +130,9 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
                           f"({shown}): they belong to a different config "
                           f"hash / naming scheme and will NOT be resumed")
 
-    logger = MetricsLogger(cfg.log_dir, run_name=cfg.run_name())
+    # lazy: a resumed-already-complete run must not touch the run directory
+    # at all (no fresh tfevents file, no figure/pkl rewrites)
+    logger = None
     eval_key = jax.random.PRNGKey(cfg.seed + 10_000)
     x_test = ds.x_test[:eval_subset] if eval_subset else ds.x_test
     results_history = []
@@ -138,6 +140,8 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     for stage, lr, passes in burda_stages(cfg.n_stages):
         if stage < start_stage:
             continue
+        if logger is None:
+            logger = MetricsLogger(cfg.log_dir, run_name=cfg.run_name())
         state = set_learning_rate(state, lr)
         active_spec = cfg.objective_spec(stage)
         print(f"stage {stage}: lr={lr:.2e}, {passes} passes, "
@@ -191,7 +195,8 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         with open(os.path.join(logger.dir, "results.pkl"), "wb") as f:
             pickle.dump(results_history, f)
 
-    logger.close()
+    if logger is not None:
+        logger.close()
     return state, results_history
 
 

@@ -163,6 +163,28 @@ class TestBackendDispatch:
             run_experiment(cfg, max_batches_per_pass=1, eval_subset=32)
 
 
+class TestExampleScript:
+    @pytest.mark.slow
+    def test_reference_style_script_runs(self, tmp_path):
+        """examples/experiment_example.py — the reference's experiment flow on
+        the backend switch (BASELINE north star) — runs end-to-end in smoke
+        mode on the real digits dataset and writes its artifacts."""
+        import subprocess
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "examples/experiment_example.py", "--smoke",
+             "--dataset", "digits", "--out-dir", str(tmp_path)],
+            capture_output=True, text=True, cwd="/root/repo", env=env,
+            timeout=500)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "done: 2 stages" in r.stdout
+        run_dirs = os.listdir(tmp_path)
+        assert len(run_dirs) == 1
+        files = os.listdir(tmp_path / run_dirs[0])
+        assert "results.pkl" in files
+        assert any(f.startswith("IWAE-2L-k_8-epoch_") for f in files)
+
+
 class TestGraftEntry:
     @pytest.mark.slow
     def test_entry_compiles(self):
