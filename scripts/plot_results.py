@@ -47,16 +47,16 @@ def trajectory(run_name: str):
 
 
 def main():
-    rows = {(r["layers"], r["loss"], r["k"]): r
-            for r in json.load(open("results/summary.json"))
-            if r["dataset"] == "digits"}
+    # key by run NAME, not (layers, loss, k): the objective-switching run also
+    # reports loss="VAE", k=50 and would shadow the plain VAE row
+    rows = {r["name"]: r for r in json.load(open("results/summary.json"))}
     fig, axes = plt.subplots(1, 2, figsize=(9.6, 3.8), sharey=True,
                              facecolor=SURFACE)
     for ax, layers in zip(axes, (1, 2)):
         ax.set_facecolor(SURFACE)
         ends = []
         for loss, k, color, ls in SERIES:
-            r = rows[(layers, loss, k)]
+            r = rows[f"digits-{layers}L-{loss}-k{k}"]
             nll = trajectory(r["run_name"])
             stages = range(1, len(nll) + 1)
             ax.plot(stages, nll, color=color, linestyle=ls, linewidth=2)

@@ -48,6 +48,18 @@ def replication_suite(n_stages: int = 8):
                 k=k, n_stages=n_stages, eval_batch_size=99,
                 log_dir=RESULTS_DIR, checkpoint_dir="checkpoints",
                 **arch)))
+    # extension family on real data: DReG (Tucker et al., the modified-
+    # gradient estimator absent from the reference code) and the two-stage
+    # objective switching of PDF Table 10 (VAE stages 1-4, IWAE from 5)
+    runs.append(("digits-1L-DReG-k50", ExperimentConfig(
+        dataset="digits", allow_synthetic=False, loss_function="DReG",
+        k=50, n_stages=n_stages, eval_batch_size=99,
+        log_dir=RESULTS_DIR, checkpoint_dir="checkpoints", **ARCH_1L)))
+    runs.append(("digits-1L-VAEtoIWAE-k50", ExperimentConfig(
+        dataset="digits", allow_synthetic=False, loss_function="VAE",
+        switch_stage=5, switch_loss="IWAE", k=50, n_stages=n_stages,
+        eval_batch_size=99, log_dir=RESULTS_DIR,
+        checkpoint_dir="checkpoints", **ARCH_1L)))
     # north-star config on the synthetic MNIST-shaped fallback
     for loss, k in (("VAE", 50), ("IWAE", 50)):
         runs.append((f"synthetic-2L-{loss}-k{k}", ExperimentConfig(
