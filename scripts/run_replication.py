@@ -24,8 +24,11 @@ import json
 import os
 import sys
 import time
+import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
 
 from iwae_replication_project_tpu.experiment import run_experiment  # noqa: E402
 from iwae_replication_project_tpu.utils.config import ExperimentConfig  # noqa: E402
@@ -147,7 +150,19 @@ def main(argv=None):
             continue
         print(f"\n=== {name} ({n_stages} stages, run {cfg.run_name()}) ===")
         t0 = time.perf_counter()
-        _, history = run_experiment(cfg)
+        try:
+            _, history = run_experiment(cfg)
+        except jax.errors.JaxRuntimeError:
+            # the remote-device transport occasionally drops a compile RPC
+            # (INTERNAL: remote_compile read body). Retry once, resuming from
+            # the last stage checkpoint. Narrow catch: deterministic errors
+            # (shape/NaN/config) must fail loudly, not re-run for minutes.
+            # These flakes happen at dispatch/compile time — before the
+            # stage's logger.log — so the retry cannot duplicate a
+            # metrics.jsonl row (and trajectory readers dedup by stage).
+            traceback.print_exc()
+            print(f"retrying {name} once after JaxRuntimeError")
+            _, history = run_experiment(cfg)
         dt = time.perf_counter() - t0
         if not history:
             print(f"--- {name}: already complete (resumed past final stage); "

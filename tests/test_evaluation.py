@@ -32,6 +32,46 @@ def setup(rng):
     return params, x
 
 
+class TestDatasetScalars:
+    def test_fused_scan_matches_per_batch_host_loop(self, rng):
+        """The single-dispatch whole-dataset program reproduces the per-batch
+        kernel loop it replaced (same fold_in(key, i) + 3-way split RNG
+        structure per batch), to accumulation-order rounding."""
+        from iwae_replication_project_tpu.evaluation.metrics import (
+            SCALAR_NAMES, dataset_scalars)
+
+        params = init_params(rng, CFG)
+        x = (jax.random.uniform(jax.random.PRNGKey(2), (24, 12)) > 0.5
+             ).astype(jnp.float32)
+        key = jax.random.PRNGKey(5)
+        k, nll_k, nll_chunk, bs = 4, 16, 8, 8
+        batches = x.reshape(3, bs, 12)
+
+        fused = np.asarray(dataset_scalars(params, CFG, key, batches, k,
+                                           nll_k, nll_chunk))
+
+        acc = {name: 0.0 for name in SCALAR_NAMES}
+        for i in range(3):
+            bkey = jax.random.fold_in(key, i)
+            k1, k2, k3 = jax.random.split(bkey, 3)
+            m = batch_metrics(params, CFG, k1, batches[i], k)
+            nll = -float(jnp.mean(streaming_log_px(params, CFG, k2,
+                                                   batches[i], k=nll_k,
+                                                   chunk=nll_chunk)))
+            acc["VAE"] += float(m["VAE"]) / 3
+            acc["IWAE"] += float(m["IWAE"]) / 3
+            acc["NLL"] += nll / 3
+            acc["E_q(h|x)[log(p(x|h))]"] += float(m["E_q(h|x)[log(p(x|h))]"]) / 3
+            acc["D_kl(q(h|x),p(h))"] += float(m["D_kl(q(h|x),p(h))"]) / 3
+            acc["D_kl(q(h|x),p(h|x))"] += (-nll - float(m["VAE"])) / 3
+            acc["reconstruction_loss"] += float(
+                reconstruction_loss(params, CFG, k3, batches[i])) / 3
+
+        for j, name in enumerate(SCALAR_NAMES):
+            np.testing.assert_allclose(fused[j], acc[name], rtol=1e-5,
+                                       atol=1e-5, err_msg=name)
+
+
 class TestStreamingNLL:
     def test_matches_one_shot_same_keys(self, setup):
         """Chunked online logsumexp == materialized logmeanexp when the chunks
