@@ -33,6 +33,7 @@ def setup(rng):
 
 
 class TestDatasetScalars:
+    @pytest.mark.slow
     def test_fused_scan_matches_per_batch_host_loop(self, rng):
         """The single-dispatch whole-dataset program reproduces the per-batch
         kernel loop it replaced (same fold_in(key, i) + 3-way split RNG
