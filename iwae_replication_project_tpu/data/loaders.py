@@ -130,9 +130,17 @@ def _load_omniglot_mat(data_dir: str) -> Optional[Tuple[np.ndarray, np.ndarray]]
 
 
 def _synthetic(name: str, n_train: int = 1024, n_test: int = 256,
-               seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+               seed: int = 0, binary: bool = True
+               ) -> Tuple[np.ndarray, np.ndarray]:
     """Deterministic digit-like blobs: mixture of per-class pixel-probability
-    templates, sampled to {0,1}. Keeps tests/benches hermetic and shape-true."""
+    templates. Keeps tests/benches hermetic and shape-true.
+
+    ``binary=True`` samples pixels to {0,1} (fixed-binarization stand-in);
+    ``binary=False`` returns the grayscale probabilities themselves, so
+    datasets whose protocol is per-epoch stochastic binarization feed the
+    re-binarization path values genuinely in (0,1) — with binary inputs,
+    ``bernoulli(p)`` is the identity and the stochastic path would be
+    exercised in name only."""
     rs = np.random.RandomState(seed + (zlib.crc32(name.encode()) % 1000))
     n_classes = 10
     yy, xx = np.mgrid[0:28, 0:28] / 27.0
@@ -149,6 +157,8 @@ def _synthetic(name: str, n_train: int = 1024, n_test: int = 256,
         rs2 = np.random.RandomState(seed2)
         cls = rs2.randint(0, n_classes, n)
         probs = templates[cls]
+        if not binary:
+            return probs.astype(np.float32)
         return (rs2.uniform(size=probs.shape) < probs).astype(np.float32)
 
     return sample(n_train, seed + 1), sample(n_test, seed + 2)
@@ -249,7 +259,10 @@ def load_dataset(name: str, data_dir: str = "data", allow_synthetic: bool = True
         banner = "=" * 78
         print(f"{banner}\nWARNING: {msg}\n{banner}", file=sys.stderr, flush=True)
         print(f"WARNING: {msg}", flush=True)
-        pair = _synthetic(name, *synthetic_sizes)
+        # stochastic-binarization datasets get grayscale synthetic values so
+        # the per-epoch re-binarization path sees real (0,1) probabilities
+        pair = _synthetic(name, *synthetic_sizes,
+                          binary=binarization != "stochastic")
 
     x_train, x_test = pair
     if bias_means is None:

@@ -69,6 +69,12 @@ def replication_suite(n_stages: int = 8):
             dataset="binarized_mnist", allow_synthetic=True,
             loss_function=loss, k=k, n_stages=n_stages,
             log_dir=RESULTS_DIR, checkpoint_dir="checkpoints", **ARCH_2L)))
+    # stochastic-binarization protocol (PDF Table 2: per-epoch on-device
+    # re-binarization — dataset "mnist" uses grayscale + stochastic policy)
+    runs.append(("synthetic-stochbin-2L-IWAE-k50", ExperimentConfig(
+        dataset="mnist", allow_synthetic=True, loss_function="IWAE",
+        k=50, n_stages=n_stages, log_dir=RESULTS_DIR,
+        checkpoint_dir="checkpoints", **ARCH_2L)))
     return runs
 
 
