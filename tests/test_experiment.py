@@ -124,6 +124,24 @@ class TestRunExperiment:
         for (ra, _), (rb, _) in zip(hist_block, hist_single):
             assert abs(ra["NLL"] - rb["NLL"]) < 1e-3, (ra["NLL"], rb["NLL"])
 
+        # and the mesh driver path: block branch == per-pass branch on the
+        # same (dp=4, sp=2) mesh (apples-to-apples, same collectives)
+        monkeypatch.setattr(exp, "PASS_BLOCK", 3)
+        cfg3 = tiny_config(tmp_path, n_stages=3, resume=False,
+                           save_figures=False, mesh_dp=4, mesh_sp=2,
+                           log_dir=str(tmp_path / "runs3"),
+                           checkpoint_dir=str(tmp_path / "ckpt3"))
+        _, hist_mesh_block = run_experiment(cfg3, eval_subset=32)
+
+        monkeypatch.setattr(exp, "PASS_BLOCK", 10**9)
+        cfg4 = tiny_config(tmp_path, n_stages=3, resume=False,
+                           save_figures=False, mesh_dp=4, mesh_sp=2,
+                           log_dir=str(tmp_path / "runs4"),
+                           checkpoint_dir=str(tmp_path / "ckpt4"))
+        _, hist_mesh_single = run_experiment(cfg4, eval_subset=32)
+        for (ra, _), (rb, _) in zip(hist_mesh_block, hist_mesh_single):
+            assert abs(ra["NLL"] - rb["NLL"]) < 1e-3, (ra["NLL"], rb["NLL"])
+
     def test_jsonl_schema(self, tmp_path):
         cfg = tiny_config(tmp_path, n_stages=1)
         run_experiment(cfg, max_batches_per_pass=1, eval_subset=32)
