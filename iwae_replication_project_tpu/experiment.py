@@ -153,7 +153,7 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
                 state, _ = block_fn(state, x_train_dev)
             remaining = passes % PASS_BLOCK
         epoch_fn = epoch_fn_for(active_spec)
-        for p in range(remaining):
+        for _ in range(remaining):
             state, _ = epoch_fn(state, x_train_dev)
 
         if mesh is not None:
