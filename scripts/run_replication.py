@@ -51,6 +51,24 @@ def replication_suite(n_stages: int = 8):
                 k=k, n_stages=n_stages, eval_batch_size=99,
                 log_dir=RESULTS_DIR, checkpoint_dir="checkpoints",
                 **arch)))
+    # alternative objectives (PDF Tables 5-9: one representative point per
+    # table) on real data, 1L k=50 like the reference's protocol
+    for name, kw in (
+            ("digits-1L-Lalpha0.5-k50", dict(loss_function="L_alpha",
+                                             alpha=0.5)),
+            ("digits-1L-Lmedian-k50", dict(loss_function="L_median")),
+            ("digits-1L-Lpower3-k50", dict(loss_function="L_power_p", p=3.0)),
+            ("digits-1L-CIWAE-b0.25-k50", dict(loss_function="CIWAE",
+                                               beta=0.25)),
+            # k is the TOTAL sample count; k1 = k // k2, so Table 9's
+            # (k1, k2) = (10, 5) point is k=50, k2=5
+            ("digits-1L-MIWAE-10x5", dict(loss_function="MIWAE", k2=5)),
+    ):
+        runs.append((name, ExperimentConfig(
+            dataset="digits", allow_synthetic=False, n_stages=n_stages,
+            eval_batch_size=99, log_dir=RESULTS_DIR,
+            checkpoint_dir="checkpoints",
+            **{"k": 50, **ARCH_1L, **kw})))
     # extension family on real data: DReG (Tucker et al., the modified-
     # gradient estimator absent from the reference code) and the two-stage
     # objective switching of PDF Table 10 (VAE stages 1-4, IWAE from 5)
