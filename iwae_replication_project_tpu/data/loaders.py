@@ -48,6 +48,11 @@ class Dataset:
     #: synthetic blobs were substituted — downstream results are not
     #: comparable to any published number.
     synthetic: bool = False
+    #: where `bias_means` came from: "raw" = raw grayscale means (the
+    #: reference's fixed-binarization policy, flexible_IWAE.py:150-155),
+    #: "train" = means of x_train itself (the default for every other
+    #: dataset, and the fallback when raw files are absent).
+    bias_source: str = "train"
 
     @property
     def output_bias(self) -> np.ndarray:
@@ -75,6 +80,15 @@ def _read_idx_images(path: str) -> np.ndarray:
         buf = f.read(n * rows * cols)
     arr = np.frombuffer(buf, dtype=np.uint8).reshape(n, rows * cols)
     return arr.astype(np.float32) / 255.0
+
+
+def _warn_loud(msg: str) -> None:
+    """Banner on stderr + plain line on stdout — the same double-channel the
+    synthetic-data fallback uses, so the warning survives both log captures."""
+    import sys
+    banner = "=" * 78
+    print(f"{banner}\nWARNING: {msg}\n{banner}", file=sys.stderr, flush=True)
+    print(f"WARNING: {msg}", flush=True)
 
 
 def _find(data_dir: str, candidates) -> Optional[str]:
@@ -220,7 +234,8 @@ def load_dataset(name: str, data_dir: str = "data", allow_synthetic: bool = True
         # bias uses RAW mnist means when available (flexible_IWAE.py:150-155)
         raw = (_load_idx_pair(os.path.join(data_dir, "mnist"), _MNIST_TRAIN, _MNIST_TEST)
                or _load_idx_pair(data_dir, _MNIST_TRAIN, _MNIST_TEST)
-               or _load_npz(data_dir, ["mnist.npz"]))
+               or _load_npz(data_dir, ["mnist.npz"])
+               or _load_npz(os.path.join(data_dir, "mnist"), ["mnist.npz"]))
         if raw is not None:
             bias_means = raw[0].mean(axis=0)
         binarization = "none"
@@ -243,6 +258,19 @@ def load_dataset(name: str, data_dir: str = "data", allow_synthetic: bool = True
         bias_means = raw_means
         binarization = "none"
 
+    # The fixed-binarization bias policy is a known tenths-of-nats NLL lever
+    # (flexible_IWAE.py:150-155): silently substituting binarized-train means
+    # would make a replication attempt quietly diverge from the reference.
+    if name == "binarized_mnist" and pair is not None and bias_means is None:
+        _warn_loud(
+            f"dataset 'binarized_mnist' loaded from {data_dir!r} WITHOUT raw "
+            f"MNIST files alongside — the decoder output bias will fall back "
+            f"to binarized-train pixel means instead of the reference's "
+            f"raw-MNIST means (flexible_IWAE.py:150-155). NLL may differ from "
+            f"published numbers by tenths of nats. Place raw idx files "
+            f"({_MNIST_TRAIN[0]}[.gz] / {_MNIST_TEST[0]}[.gz]) or mnist.npz "
+            f"in {data_dir!r} (or its mnist/ subdir) to restore the policy.")
+
     synthetic = False
     if pair is None:
         if not allow_synthetic:
@@ -250,23 +278,27 @@ def load_dataset(name: str, data_dir: str = "data", allow_synthetic: bool = True
                 f"dataset {name!r} not found under {data_dir!r} and synthetic "
                 f"fallback disabled")
         synthetic = True
-        import sys
-        msg = (f"dataset {name!r} NOT FOUND under {data_dir!r} — substituting "
-               f"SYNTHETIC blobs. Results are NOT comparable to published "
-               f"numbers. Place real files in {data_dir!r} (see data/loaders.py "
-               f"docstring / scripts/prepare_data.py) or pass "
-               f"allow_synthetic=False to fail instead.")
-        banner = "=" * 78
-        print(f"{banner}\nWARNING: {msg}\n{banner}", file=sys.stderr, flush=True)
-        print(f"WARNING: {msg}", flush=True)
+        # any bias means gathered from real raw files must not leak into the
+        # synthetic run: initializing the decoder bias to real-MNIST pixel
+        # means while training on blobs would both skew the fake run and let
+        # metrics certify `raw_means_bias` on data the policy never saw
+        bias_means = None
+        _warn_loud(
+            f"dataset {name!r} NOT FOUND under {data_dir!r} — substituting "
+            f"SYNTHETIC blobs. Results are NOT comparable to published "
+            f"numbers. Place real files in {data_dir!r} (see data/loaders.py "
+            f"docstring / scripts/prepare_data.py) or pass "
+            f"allow_synthetic=False to fail instead.")
         # stochastic-binarization datasets get grayscale synthetic values so
         # the per-epoch re-binarization path sees real (0,1) probabilities
         pair = _synthetic(name, *synthetic_sizes,
                           binary=binarization != "stochastic")
 
     x_train, x_test = pair
+    bias_source = "raw"
     if bias_means is None:
         bias_means = x_train.mean(axis=0)
+        bias_source = "train"
     return Dataset(name=name, x_train=x_train, x_test=x_test,
                    bias_means=bias_means, binarization=binarization,
-                   synthetic=synthetic)
+                   synthetic=synthetic, bias_source=bias_source)

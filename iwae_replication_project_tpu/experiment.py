@@ -176,8 +176,10 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         res["learning_rate"] = lr
         res["stage"] = stage
         # make fake-data runs unmistakable in every artifact (metrics.jsonl,
-        # results.pkl, stdout)
+        # results.pkl, stdout), and record which bias policy the decoder was
+        # initialized under (raw-means = the reference's fixed-bin policy)
         res["synthetic_data"] = bool(ds.synthetic)
+        res["raw_means_bias"] = ds.bias_source == "raw"
         print({k: round(v, 4) for k, v in res.items() if isinstance(v, float)})
         logger.log(res, step=int(state.step))
         results_history.append((res, {
@@ -241,6 +243,7 @@ def _run_experiment_torch(cfg: ExperimentConfig,
         res["learning_rate"] = lr
         res["stage"] = stage
         res["synthetic_data"] = bool(ds.synthetic)
+        res["raw_means_bias"] = ds.bias_source == "raw"
         print({k: round(v, 4) for k, v in res.items() if isinstance(v, float)})
         logger.log(res, step=step_count)
         results_history.append((res, {

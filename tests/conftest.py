@@ -19,6 +19,15 @@ import pytest  # noqa: E402
 # config API (must happen before the first backend initialization).
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: many driver-level tests compile identical
+# tiny programs (same shapes via tiny_config), and this host has one CPU core,
+# so compilation dominates suite wall-time. Cold run populates the cache;
+# warm runs cut the fast profile roughly in half. The dir is gitignored.
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
 
 @pytest.fixture(scope="session")
 def devices():

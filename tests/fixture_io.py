@@ -1,0 +1,16 @@
+"""Shared fixture-file writers for the reference's on-disk data formats."""
+
+import gzip
+import struct
+
+import numpy as np
+
+
+def write_idx_gz(path, images_uint8: np.ndarray) -> None:
+    """Write MNIST idx3-ubyte .gz: the raw-MNIST format the reference's data
+    pipeline downloads (experiment_example.py:25-31). `images_uint8` is
+    [N, 28, 28] or [N, 784] uint8."""
+    arr = np.ascontiguousarray(images_uint8, dtype=np.uint8)
+    n = len(arr)
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28) + arr.tobytes())

@@ -71,13 +71,10 @@ class TestLoaders:
     def test_fashion_mnist_does_not_steal_root_mnist_files(self, tmp_path):
         """Root-level idx files belong to plain MNIST; fashion_mnist must not
         silently load them (same filenames, different dataset)."""
-        import gzip
-        import struct
+        from fixture_io import write_idx_gz
         img = np.zeros((3, 28, 28), np.uint8)
-        for split, n in (("train-images-idx3-ubyte.gz", 3),
-                         ("t10k-images-idx3-ubyte.gz", 3)):
-            with gzip.open(tmp_path / split, "wb") as f:
-                f.write(struct.pack(">IIII", 2051, n, 28, 28) + img.tobytes())
+        for split in ("train-images-idx3-ubyte.gz", "t10k-images-idx3-ubyte.gz"):
+            write_idx_gz(tmp_path / split, img)
         assert load_dataset("mnist", data_dir=str(tmp_path),
                             allow_synthetic=False).x_train.shape == (3, 784)
         with pytest.raises(FileNotFoundError):
@@ -86,7 +83,7 @@ class TestLoaders:
 
 
 class TestRealFormatLoaders:
-    def test_amat_loading(self, tmp_path):
+    def test_amat_loading(self, tmp_path, capsys):
         """Larochelle-format .amat text files (the reference's
         binarized-MNIST source, README.md:10)."""
         rs = np.random.RandomState(3)
@@ -100,8 +97,13 @@ class TestRealFormatLoaders:
         np.testing.assert_array_equal(ds.x_train, xtr)
         np.testing.assert_array_equal(ds.x_test, xte)
         assert ds.binarization == "none"
-        # no raw MNIST present -> bias falls back to the binary train means
+        # no raw MNIST present -> bias falls back to the binary train means,
+        # and says so loudly (this is a known NLL lever, VERDICT r3 Weak #2)
         np.testing.assert_allclose(ds.bias_means, xtr.mean(0))
+        assert ds.bias_source == "train"
+        out = capsys.readouterr()
+        assert "WITHOUT raw MNIST" in out.out
+        assert "WITHOUT raw MNIST" in out.err
 
     def test_amat_with_raw_mnist_bias_policy(self, tmp_path):
         """With raw MNIST alongside, the fixed-bin bias must use the RAW
@@ -120,6 +122,7 @@ class TestRealFormatLoaders:
             ds.bias_means,
             (raw_train.reshape(-1, 784).astype(np.float32) / 255.0).mean(0),
             rtol=1e-6)
+        assert ds.bias_source == "raw"
 
     def test_omniglot_chardata_mat(self, tmp_path):
         """Burda-split Omniglot chardata.mat (flexible_IWAE.py:164-165):
@@ -151,6 +154,21 @@ class TestRealFormatLoaders:
         np.testing.assert_array_equal(ds.x_train, ds2.x_train)
         # bias comes from raw grayscale means, not the binarized pixels
         assert not np.allclose(ds.bias_means, ds.x_train.mean(0))
+        assert ds.bias_source == "raw"
+
+    def test_synthetic_fallback_never_claims_raw_bias(self, tmp_path):
+        """Raw MNIST idx/npz present but NO .amat pair -> synthetic blobs are
+        substituted; the raw means must NOT leak into the bias init (metrics
+        would otherwise certify raw_means_bias=1 on a fake-data run)."""
+        rs = np.random.RandomState(6)
+        np.savez(tmp_path / "mnist.npz",
+                 x_train=rs.randint(0, 256, (4, 28, 28)).astype(np.uint8),
+                 x_test=rs.randint(0, 256, (2, 28, 28)).astype(np.uint8))
+        ds = load_dataset("binarized_mnist", data_dir=str(tmp_path),
+                          allow_synthetic=True)
+        assert ds.synthetic
+        assert ds.bias_source == "train"
+        np.testing.assert_allclose(ds.bias_means, ds.x_train.mean(0))
 
     def test_synthetic_fallback_is_loud_and_flagged(self, tmp_path, capsys):
         ds = load_dataset("mnist", data_dir=str(tmp_path), allow_synthetic=True)
