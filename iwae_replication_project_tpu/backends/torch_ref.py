@@ -95,16 +95,36 @@ class TorchFlexibleModel(FlexibleModel):
         for g in self.optimizer.param_groups:
             g["lr"] = lr
 
-    def _encode(self, x, k: int):
+    def _encode(self, x, k: int, stop_q_score: bool = False, h_fixed=None):
+        """Encoder pass. `stop_q_score` detaches the density parameters inside
+        log q while keeping the pathwise sample dependence (the score-term
+        removal of STL/DReG). `h_fixed` replays given latent values through the
+        reparameterization (eps recovered with detached moments) so gradients
+        can be compared against another backend's draw-for-draw.
+        """
+        sg = (lambda t: t.detach()) if stop_q_score else (lambda t: t)
+
+        def draw(mu, std, i, shape):
+            if h_fixed is None:
+                return mu + std * torch.randn(shape)
+            given = torch.as_tensor(np.array(h_fixed[i], dtype=np.float32))
+            if tuple(given.shape) != tuple(shape):
+                raise ValueError(
+                    f"h_fixed[{i}] has shape {tuple(given.shape)}, expected "
+                    f"{tuple(shape)} — k / latent sizes of the replayed draws "
+                    f"must match this model")
+            eps = ((given - mu) / std).detach()
+            return mu + std * eps
+
         mu, std = self.enc[0](x)
-        h1 = mu + std * torch.randn((k,) + mu.shape)
-        log_q = _normal_log_prob(h1, mu, std).sum(-1)
+        h1 = draw(mu, std, 0, (k,) + mu.shape)
+        log_q = _normal_log_prob(h1, sg(mu), sg(std)).sum(-1)
         h = [h1]
         q_last = (mu, std)
         for i in range(1, self.L):
             mu, std = self.enc[i](h[-1])
-            hi = mu + std * torch.randn(mu.shape)
-            log_q = log_q + _normal_log_prob(hi, mu, std).sum(-1)
+            hi = draw(mu, std, i, mu.shape)
+            log_q = log_q + _normal_log_prob(hi, sg(mu), sg(std)).sum(-1)
             h.append(hi)
             q_last = (mu, std)
         return h, log_q, q_last
@@ -113,8 +133,10 @@ class TorchFlexibleModel(FlexibleModel):
         probs = torch.sigmoid(self.out(h1))
         return probs * _PCLAMP_SCALE + _PCLAMP_SHIFT
 
-    def _log_weights_aux(self, x, k: int):
-        h, log_q, q_last = self._encode(x, k)
+    def _log_weights_aux(self, x, k: int, stop_q_score: bool = False,
+                         h_fixed=None):
+        h, log_q, q_last = self._encode(x, k, stop_q_score=stop_q_score,
+                                        h_fixed=h_fixed)
         probs = self._decode_probs(h[0])
         log_pxIh = (x * torch.log(probs) + (1 - x) * torch.log1p(-probs)).sum(-1)
         log_ph = (-0.5 * h[-1] ** 2 - 0.5 * float(np.log(2 * np.pi))).sum(-1)
@@ -131,6 +153,13 @@ class TorchFlexibleModel(FlexibleModel):
     def _iwae(log_w):
         m = log_w.max(dim=0, keepdim=True).values.detach()
         return (torch.log(torch.exp(log_w - m).mean(0)) + m[0]).mean()
+
+    @staticmethod
+    def _miwae(log_w, k2: int):
+        """Mean of k2 independent IWAE(k//k2) bounds, group-major reshape."""
+        g = log_w.reshape(k2, log_w.shape[0] // k2, *log_w.shape[1:])
+        m = g.max(dim=1, keepdim=True).values.detach()
+        return (torch.log(torch.exp(g - m).mean(1)) + m[:, 0]).mean()
 
     def _bound(self, name, x, k, **over):
         x = self._flatten(x)
@@ -151,16 +180,102 @@ class TorchFlexibleModel(FlexibleModel):
             a = over.get("alpha", self.alpha)
             return (1 - a) * aux["log_px_given_h"].mean() + a * log_w.mean()
         if name == "MIWAE":
-            k2 = over.get("k2", self.k2)
-            g = log_w.reshape(k2, k // k2, *log_w.shape[1:])
-            m = g.max(dim=1, keepdim=True).values.detach()
-            return (torch.log(torch.exp(g - m).mean(1)) + m[:, 0]).mean()
+            return self._miwae(log_w, over.get("k2", self.k2))
         if name == "VAE_V1":
             mu, std = aux["q_last"]
             kl = (-0.5 * (1 + 2 * torch.log(std) - mu ** 2 - std ** 2)).sum(-1).mean()
             return aux["log_px_given_h"].mean() - kl
         raise NotImplementedError(
             f"objective {name!r} is not implemented in the torch oracle backend")
+
+    # ------------------------------------------------------------------
+    # modified-gradient estimators (DReG / STL / PIWAE)
+    #
+    # Independent oracle for objectives/gradients.py:64-109: where the JAX
+    # path hand-rolls VJP cotangents on the [k, B] log-weight tensor, this
+    # backend derives the same gradients from torch *autograd* on surrogate
+    # scalars (Roeder et al. 2017; Tucker et al. 2018; Rainforth et al. 2018
+    # — PAPERS.md), so a subtle cotangent bug cannot hide in both.
+    # ------------------------------------------------------------------
+
+    def _param_groups(self):
+        enc = list(self.enc.parameters())
+        rest = list(self.dec.parameters()) + list(self.out.parameters())
+        return enc, rest
+
+    def _estimator_value_and_grads(self, x, name: str, k: int, k2: int = 1,
+                                   h_fixed=None):
+        """``(bound, {param: grad})`` for DReG/STL/PIWAE.
+
+        * STL: autograd of the IWAE bound on the score-stopped graph —
+          surrogate sum_i sg(w~_i) log w_i / B.
+        * DReG: encoder surrogate uses sg(w~_i^2), decoder sg(w~_i), both on
+          the score-stopped graph.
+        * PIWAE: decoder from the full-k IWAE bound, encoder from the
+          MIWAE(k1, k2) bound, one shared (standard, score-carrying) graph.
+        """
+        x = self._flatten(x)
+        enc_p, rest_p = self._param_groups()
+        grads: Dict = {}
+        if name in ("DReG", "STL"):
+            log_w, _ = self._log_weights_aux(x, k, stop_q_score=True,
+                                             h_fixed=h_fixed)
+            B = log_w.shape[1]
+            w = torch.softmax(log_w, dim=0).detach()
+            bound = self._iwae(log_w).detach()
+            s_dec = (w * log_w).sum() / B
+            if name == "STL":
+                g = torch.autograd.grad(s_dec, enc_p + rest_p)
+                grads.update(zip(enc_p + rest_p, g))
+            else:
+                s_enc = (w.pow(2) * log_w).sum() / B
+                g_enc = torch.autograd.grad(s_enc, enc_p, retain_graph=True)
+                g_dec = torch.autograd.grad(s_dec, rest_p)
+                grads.update(zip(enc_p, g_enc))
+                grads.update(zip(rest_p, g_dec))
+        elif name == "PIWAE":
+            log_w, _ = self._log_weights_aux(x, k, h_fixed=h_fixed)
+            bound = self._iwae(log_w)
+            g_dec = torch.autograd.grad(bound, rest_p, retain_graph=True)
+            g_enc = torch.autograd.grad(self._miwae(log_w, k2), enc_p)
+            grads.update(zip(enc_p, g_enc))
+            grads.update(zip(rest_p, g_dec))
+            bound = bound.detach()
+        else:
+            raise NotImplementedError(name)
+        return bound, grads
+
+    def _iter_linear_tree(self):
+        """Yield ``(torch.nn.Linear, jax-tree-path)`` pairs — the single
+        source of truth for the torch-module <-> JAX-pytree correspondence
+        (drives both load_jax_params and the gradient export)."""
+        for group, blocks in (("enc", self.enc), ("dec", self.dec)):
+            for i, blk in enumerate(blocks):
+                for nm in ("l1", "l2", "mu", "lstd"):
+                    yield getattr(blk, nm), (group, i, nm)
+        for idx, nm in ((0, "l1"), (2, "l2"), (4, "out")):
+            yield self.out[idx], ("out", nm)
+
+    def estimator_gradients_as_jax_tree(self, x, name: str, k: int,
+                                        k2: int = 1, h_fixed=None):
+        """``(bound, grad-pytree)`` in the JAX param layout (``w`` transposed
+        back to ``[in, out]``) — the cross-backend gradient-parity hook.
+        `h_fixed` should be the latents from the JAX forward (aux["h"]) so
+        both backends differentiate the same realized reparameterization."""
+        bound, grads = self._estimator_value_and_grads(x, name, k, k2=k2,
+                                                       h_fixed=h_fixed)
+        tree = {"enc": [{} for _ in self.enc], "dec": [{} for _ in self.dec],
+                "out": {}}
+        for linear, path in self._iter_linear_tree():
+            leaf = {"w": np.asarray(grads[linear.weight].detach()).T.copy(),
+                    "b": np.asarray(grads[linear.bias].detach()).copy()}
+            node = tree[path[0]]
+            for pkey in path[1:-1]:
+                node = node[pkey]
+            node[path[-1]] = leaf
+        tree["enc"] = tuple(tree["enc"])
+        tree["dec"] = tuple(tree["dec"])
+        return float(bound), tree
 
     def get_L(self, x, k: int = 5000):
         return self._bound("VAE", x, k)
@@ -189,6 +304,15 @@ class TorchFlexibleModel(FlexibleModel):
     def train_step(self, x) -> Dict[str, float]:
         if self.optimizer is None:
             raise RuntimeError("call .compile() first")
+        if self.loss_function in ("DReG", "STL", "PIWAE"):
+            bound, grads = self._estimator_value_and_grads(
+                x, self.loss_function, self.k, k2=self.k2)
+            self.optimizer.zero_grad()
+            for p, g in grads.items():
+                p.grad = -g  # ascend the bound
+            self.optimizer.step()
+            self.epoch += 1
+            return {self.loss_function: float(-bound)}
         loss = -self._bound(self.loss_function, x, self.k)
         self.optimizer.zero_grad()
         loss.backward()
@@ -383,28 +507,14 @@ class TorchFlexibleModel(FlexibleModel):
         """Copy a JAX param pytree (models/iwae.init_params layout) into this
         oracle — weight-tied cross-backend parity testing. JAX kernels are
         ``[in, out]``; torch Linear stores ``[out, in]``."""
-        def cp(linear, d):
+        for linear, path in self._iter_linear_tree():
+            d = params
+            for pkey in path:
+                d = d[pkey]
             with torch.no_grad():
                 linear.weight.copy_(torch.from_numpy(
                     np.ascontiguousarray(np.asarray(d["w"]).T)))
                 linear.bias.copy_(torch.from_numpy(np.asarray(d["b"]).copy()))
-
-        for i, blk in enumerate(self.enc):
-            p = params["enc"][i]
-            cp(blk.l1, p["l1"])
-            cp(blk.l2, p["l2"])
-            cp(blk.mu, p["mu"])
-            cp(blk.lstd, p["lstd"])
-        for i, blk in enumerate(self.dec):
-            p = params["dec"][i]
-            cp(blk.l1, p["l1"])
-            cp(blk.l2, p["l2"])
-            cp(blk.mu, p["mu"])
-            cp(blk.lstd, p["lstd"])
-        out = params["out"]
-        cp(self.out[0], out["l1"])
-        cp(self.out[2], out["l2"])
-        cp(self.out[4], out["out"])
         return self
 
     def get_NLL(self, x, k: int = 5000, chunk: int = 100):
