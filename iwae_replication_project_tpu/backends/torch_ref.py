@@ -438,7 +438,7 @@ class TorchFlexibleModel(FlexibleModel):
     def get_NLL_without_inactive_units(self, x, threshold: float = 0.01,
                                        n_samples: int = 5000,
                                        activity_samples: int = 1000,
-                                       chunk: int = 100):
+                                       chunk: int = 250):
         x = self._flatten(x)
         variances, eig = self.get_levels_of_units_activity(x, activity_samples)
         masks, _, _ = self.get_active_units(variances, eig, threshold)
@@ -457,7 +457,7 @@ class TorchFlexibleModel(FlexibleModel):
             return -(torch.log(s / n_samples) + m).mean()
 
     def get_training_statistics(self, x, k: int, batch_size: int = 100,
-                                nll_k: int = 5000, nll_chunk: int = 100,
+                                nll_k: int = 5000, nll_chunk: int = 250,
                                 activity_samples: int = 1000,
                                 activity_threshold: float = 0.01,
                                 include_pruned_nll: bool = True):
@@ -517,7 +517,7 @@ class TorchFlexibleModel(FlexibleModel):
                 linear.bias.copy_(torch.from_numpy(np.asarray(d["b"]).copy()))
         return self
 
-    def get_NLL(self, x, k: int = 5000, chunk: int = 100):
+    def get_NLL(self, x, k: int = 5000, chunk: int = 250):
         """Streaming large-k NLL (no_grad, chunked like the JAX path)."""
         if k % chunk != 0:
             raise ValueError(f"chunk={chunk} must divide k={k}")

@@ -98,7 +98,7 @@ def _masked_log_weights(params, cfg: model.ModelConfig, key: jax.Array,
 @partial(jax.jit, static_argnames=("cfg", "k", "chunk"))
 def nll_without_inactive_units(params, cfg: model.ModelConfig, key: jax.Array,
                                x: jax.Array, masks, k: int = 5000,
-                               chunk: int = 100) -> jax.Array:
+                               chunk: int = 250) -> jax.Array:
     """-L_k with pruned latents — the 'cost of pruning' diagnostic (PDF §4.2.1),
     streamed in k-chunks like the unpruned NLL. One XLA program (a `lax.scan`
     over chunks) rather than a host loop of per-chunk dispatches; the per-chunk

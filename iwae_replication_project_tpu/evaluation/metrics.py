@@ -61,7 +61,7 @@ def batch_metrics(params, cfg: model.ModelConfig, key: jax.Array, x: jax.Array,
 
 @partial(jax.jit, static_argnames=("cfg", "k", "chunk"))
 def streaming_log_px(params, cfg: model.ModelConfig, key: jax.Array, x: jax.Array,
-                     k: int = 5000, chunk: int = 100) -> jax.Array:
+                     k: int = 5000, chunk: int = 250) -> jax.Array:
     """Per-example IWAE-k log-likelihood estimate ``[B]``, O(chunk) memory.
 
     Each scan iteration draws `chunk` fresh importance samples (independent key
@@ -80,7 +80,7 @@ def streaming_log_px(params, cfg: model.ModelConfig, key: jax.Array, x: jax.Arra
 
 
 def streaming_nll(params, cfg: model.ModelConfig, key: jax.Array, x: jax.Array,
-                  k: int = 5000, chunk: int = 100) -> jax.Array:
+                  k: int = 5000, chunk: int = 250) -> jax.Array:
     """scalar NLL = -mean_B log p̂(x) (flexible_IWAE.py:463-464 semantics)."""
     return -jnp.mean(streaming_log_px(params, cfg, key, x, k=k, chunk=chunk))
 
@@ -142,7 +142,7 @@ def dataset_scalars(params, cfg: model.ModelConfig, key: jax.Array,
 
 def training_statistics(params, cfg: model.ModelConfig, key: jax.Array,
                         x_test: jax.Array, k: int, batch_size: int = 100,
-                        nll_k: int = 5000, nll_chunk: int = 100,
+                        nll_k: int = 5000, nll_chunk: int = 250,
                         activity_samples: int = 1000,
                         activity_threshold: float = 0.01,
                         include_pruned_nll: bool = True

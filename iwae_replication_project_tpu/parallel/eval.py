@@ -112,7 +112,7 @@ def _validate_eval_k(name: str, k: int, n_sp: int) -> int:
 
 @functools.lru_cache(maxsize=32)
 def make_parallel_streaming_log_px(cfg: model.ModelConfig, mesh, k: int = 5000,
-                                   chunk: int = 100):
+                                   chunk: int = 250):
     """``(params, key, x) -> [B] log p̂(x)`` with batch over dp, k over sp.
 
     Each device scans ``k/sp`` fresh importance samples in `chunk`-sized
@@ -212,7 +212,7 @@ def make_parallel_posterior_means(cfg: model.ModelConfig, mesh,
 
 @functools.lru_cache(maxsize=32)
 def make_parallel_pruned_nll(cfg: model.ModelConfig, mesh, k: int = 5000,
-                             chunk: int = 100, n_layers: int = 1):
+                             chunk: int = 250, n_layers: int = 1):
     """Masked-latent NLL (flexible_IWAE.py:466-494) with k sharded over sp;
     the (small, first-batch) `x` is replicated."""
     n_sp = mesh.shape[AXES.sp]
@@ -295,7 +295,7 @@ def make_parallel_dataset_scalars(cfg: model.ModelConfig, mesh, k: int,
 def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
                                  key: jax.Array, x_test: jax.Array, k: int,
                                  batch_size: int = 100, nll_k: int = 5000,
-                                 nll_chunk: int = 100,
+                                 nll_chunk: int = 250,
                                  activity_samples: int = 1000,
                                  activity_threshold: float = 0.01,
                                  include_pruned_nll: bool = True

@@ -181,9 +181,11 @@ def main(argv=None):
             # (INTERNAL: remote_compile read body). Retry once, resuming from
             # the last stage checkpoint. Narrow catch: deterministic errors
             # (shape/NaN/config) must fail loudly, not re-run for minutes.
-            # These flakes happen at dispatch/compile time — before the
-            # stage's logger.log — so the retry cannot duplicate a
-            # metrics.jsonl row (and trajectory readers dedup by stage).
+            # A flake landing between a stage's logger.log and its
+            # save_checkpoint (e.g. during the figure dispatches) makes the
+            # retry resume from the PREVIOUS stage and re-log that stage —
+            # duplicate metrics.jsonl rows are possible; all downstream
+            # readers dedup by stage (last row wins).
             traceback.print_exc()
             print(f"retrying {name} once after JaxRuntimeError")
             _, history = run_experiment(cfg)

@@ -148,8 +148,9 @@ class TestRunExperiment:
         path = os.path.join(cfg.log_dir, cfg.run_name(), "metrics.jsonl")
         rec = json.loads(open(path).read().strip().splitlines()[-1])
         for key in ("VAE", "IWAE", "NLL", "reconstruction_loss", "step",
-                    "synthetic_data"):
+                    "synthetic_data", "raw_means_bias", "nll_chunk"):
             assert key in rec, key
+        assert rec["nll_chunk"] == cfg.nll_chunk  # eval-RNG version stamp
         assert bool(rec["synthetic_data"])  # tiny runs use blob fallback
 
     def test_stage_figures_written(self, tmp_path):
