@@ -203,6 +203,9 @@ class JaxFlexibleModel(FlexibleModel):
 
     def get_NLL(self, x, k: int = 5000, chunk: int = 250):
         self._require_compiled()
+        # clamp so small/odd k keeps working with the (round-4) 250 default;
+        # the low-level streaming kernel still rejects non-divisors loudly
+        chunk = ev.largest_divisor_leq(k, chunk)
         return ev.streaming_nll(self.params, self.cfg, self._next_eval_key(),
                                 self._flatten(x), k=k, chunk=chunk)
 

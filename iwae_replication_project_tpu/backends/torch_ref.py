@@ -475,7 +475,8 @@ class TorchFlexibleModel(FlexibleModel):
 
         acc = {"VAE": 0.0, "IWAE": 0.0, "NLL": 0.0,
                "E_q(h|x)[log(p(x|h))]": 0.0, "D_kl(q(h|x),p(h))": 0.0,
-               "D_kl(q(h|x),p(h|x))": 0.0, "reconstruction_loss": 0.0}
+               "D_kl(q(h|x),p(h|x))": 0.0, "reconstruction_loss": 0.0,
+               "nll_chunk": float(nll_chunk)}  # eval-RNG version stamp
         with torch.no_grad():
             for i in range(n_batches):
                 xb = x[i * batch_size:(i + 1) * batch_size]
@@ -518,9 +519,12 @@ class TorchFlexibleModel(FlexibleModel):
         return self
 
     def get_NLL(self, x, k: int = 5000, chunk: int = 250):
-        """Streaming large-k NLL (no_grad, chunked like the JAX path)."""
-        if k % chunk != 0:
-            raise ValueError(f"chunk={chunk} must divide k={k}")
+        """Streaming large-k NLL (no_grad, chunked like the JAX path). A chunk
+        that does not divide k is clamped to the largest divisor, matching the
+        JAX facade."""
+        from iwae_replication_project_tpu.evaluation.metrics import (
+            largest_divisor_leq)
+        chunk = largest_divisor_leq(k, chunk)
         x = self._flatten(x)
         with torch.no_grad():
             m = torch.full((x.shape[0],), -float("inf"))

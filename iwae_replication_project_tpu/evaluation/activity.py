@@ -102,7 +102,13 @@ def nll_without_inactive_units(params, cfg: model.ModelConfig, key: jax.Array,
     """-L_k with pruned latents — the 'cost of pruning' diagnostic (PDF §4.2.1),
     streamed in k-chunks like the unpruned NLL. One XLA program (a `lax.scan`
     over chunks) rather than a host loop of per-chunk dispatches; the per-chunk
-    RNG folds are unchanged."""
+    RNG folds are unchanged. A chunk that does not divide k is clamped to the
+    largest divisor (a silent k//chunk==0 would finalize an empty carry into
+    NaN)."""
+    from iwae_replication_project_tpu.evaluation.metrics import (
+        largest_divisor_leq)
+    chunk = largest_divisor_leq(k, chunk)
+
     def body(state, i):
         lw = _masked_log_weights(params, cfg, jax.random.fold_in(key, i), x,
                                  masks, chunk)

@@ -180,10 +180,8 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         # initialized under (raw-means = the reference's fixed-bin policy)
         res["synthetic_data"] = bool(ds.synthetic)
         res["raw_means_bias"] = ds.bias_source == "raw"
-        # the chunk size versions the eval RNG stream — NLL numbers are only
-        # comparable draw-for-draw at equal nll_chunk. Stamp the EFFECTIVE
-        # chunk (the eval drivers clamp to a divisor of nll_k), not the ask.
-        res["nll_chunk"] = ev.largest_divisor_leq(cfg.nll_k, cfg.nll_chunk)
+        # `res` already carries "nll_chunk" — the EFFECTIVE chunk the eval
+        # driver used (clamped per device under sp) — as the eval-RNG version
         print({k: round(v, 4) for k, v in res.items() if isinstance(v, float)})
         logger.log(res, step=int(state.step))
         results_history.append((res, {
@@ -248,7 +246,6 @@ def _run_experiment_torch(cfg: ExperimentConfig,
         res["stage"] = stage
         res["synthetic_data"] = bool(ds.synthetic)
         res["raw_means_bias"] = ds.bias_source == "raw"
-        res["nll_chunk"] = ev.largest_divisor_leq(cfg.nll_k, cfg.nll_chunk)
         print({k: round(v, 4) for k, v in res.items() if isinstance(v, float)})
         logger.log(res, step=step_count)
         results_history.append((res, {

@@ -338,6 +338,9 @@ def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
 
     scalars = np.asarray(scalars_fn(params, key, batches))
     acc = {name: float(v) for name, v in zip(SCALAR_NAMES, scalars)}
+    # the per-DEVICE chunk actually used (clamped against nll_k/sp inside
+    # make_parallel_dataset_scalars) — the eval-RNG version stamp
+    acc["nll_chunk"] = float(largest_divisor_leq(nll_k // n_sp, nll_chunk))
 
     res2: Dict[str, object] = {}
     k_au, k_pruned = jax.random.split(jax.random.fold_in(key, n_batches))

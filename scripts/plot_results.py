@@ -37,12 +37,14 @@ SERIES = [  # (loss, k, color, linestyle)
 
 
 def trajectory(run_name: str):
-    """NLL by stage, first record per stage (resumed/extended runs append)."""
+    """NLL by stage, LAST record per stage winning — resumed/extended runs
+    (and the replication driver's flake-retry) may append a duplicate stage
+    row; the newest reflects the state that was actually checkpointed."""
     path = os.path.join("results/runs", run_name, "metrics.jsonl")
     by_stage = {}
     for line in open(path):
         rec = json.loads(line)
-        by_stage.setdefault(rec["stage"], rec["NLL"])
+        by_stage[rec["stage"]] = rec["NLL"]
     return [by_stage[s] for s in sorted(by_stage)]
 
 

@@ -1,0 +1,151 @@
+"""Capture + summarize TPU traces for the two production hot paths.
+
+Produces the trace evidence VERDICT r2/r3 asked for (`utils/profiling.trace`
+pointed at real work, with a committable per-op breakdown):
+
+1. one scanned train epoch (training/epoch.make_epoch_fn) at the flagship
+   IWAE k=50 2L shape — the program bench.py's `value` measures;
+2. one fused whole-testset eval dispatch (evaluation/metrics.dataset_scalars)
+   at the production nll_k=5000 / chunk=250 config.
+
+For each, a `jax.profiler` trace is written under --out (xplane.pb +
+trace.json.gz, regenerable, NOT meant for commit), and a compact per-category
+op table is extracted with xprof's converter into
+``results/profile/{train,eval}_op_profile.json`` — the committable artifact.
+
+Usage:  python scripts/profile_trace.py [--out /tmp/iwae_trace]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_TRAIN = 5000
+BATCH = 100
+K = 50
+EVAL_N = 10000
+EVAL_K = 5000
+EVAL_CHUNK = 250
+
+
+def _capture(tag: str, out_root: str, fn) -> str:
+    """Run `fn` (already warmed) under a profiler trace; return the trace dir."""
+    from iwae_replication_project_tpu.utils.profiling import trace
+
+    logdir = os.path.join(out_root, tag)
+    with trace(logdir):
+        fn()
+    return logdir
+
+
+def _summarize(logdir: str):
+    """xplane.pb -> nested {program -> category -> top ops} dict with raw
+    times (ps) and FLOP-utilization fractions, via xprof's converter."""
+    from xprof.convert import raw_to_tool_data as rtd
+
+    xs = glob.glob(os.path.join(logdir, "plugins/profile/*/*.xplane.pb"))
+    if not xs:
+        raise RuntimeError(f"no xplane.pb under {logdir}")
+    data, _ = rtd.xspace_to_tool_data(xs, "op_profile", {})
+    d = json.loads(data if isinstance(data, str) else data.decode())
+    root = d["byProgramExcludeIdle"]
+    programs = []
+    for prog in sorted(root.get("children", []),
+                       key=lambda c: -c["metrics"].get("rawTime", 0))[:3]:
+        pm = prog["metrics"]
+        cats = []
+        for cat in sorted(prog.get("children", []),
+                          key=lambda c: -c["metrics"].get("rawTime", 0)):
+            cm = cat["metrics"]
+            if cm.get("rawTime", 0) == 0:
+                continue
+            cats.append({
+                "category": cat["name"],
+                "time_ms": round(cm["rawTime"] / 1e9, 4),
+                "pct_of_program": round(100 * cm["rawTime"] / pm["rawTime"], 1),
+                "flop_util_pct_of_peak": round(cm.get("flops", 0) * 100, 2),
+                "top_ops": [
+                    {"name": op["name"][:60],
+                     "time_ms": round(op["metrics"]["rawTime"] / 1e9, 4),
+                     "flop_util_pct": round(op["metrics"].get("flops", 0) * 100, 2)}
+                    for op in sorted(cat.get("children", []),
+                                     key=lambda c: -c["metrics"].get("rawTime", 0))[:3]
+                ],
+            })
+        programs.append({
+            "program": prog["name"],
+            "device_time_ms": round(pm["rawTime"] / 1e9, 3),
+            "flop_util_pct_of_peak": round(pm.get("flops", 0) * 100, 2),
+            "counted_gflops": round(pm.get("bf16Flops", 0) / 1e9, 1),
+            "note": ("FLOPs inside custom-call (Pallas) ops are invisible to "
+                     "XLA's counter, so program-level util understates true "
+                     "utilization"),
+            "categories": cats,
+        })
+    return {"device_type": d.get("deviceType"), "programs": programs}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="/tmp/iwae_trace",
+                    help="trace output root (xplane/trace.json, regenerable)")
+    ap.add_argument("--summary-dir", default="results/profile",
+                    help="where the committable op-table JSONs land")
+    ns = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from iwae_replication_project_tpu.evaluation.metrics import dataset_scalars
+    from iwae_replication_project_tpu.models import ModelConfig
+    from iwae_replication_project_tpu.objectives import ObjectiveSpec
+    from iwae_replication_project_tpu.training import create_train_state
+    from iwae_replication_project_tpu.training.epoch import make_epoch_fn
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    cfg = ModelConfig.two_layer(likelihood="logits", fused_likelihood=on_tpu)
+    state = create_train_state(jax.random.PRNGKey(0), cfg)
+    spec = ObjectiveSpec("IWAE", k=K)
+    epoch = make_epoch_fn(spec, cfg, N_TRAIN, BATCH, donate=False)
+    x = jnp.asarray((np.random.RandomState(0).rand(N_TRAIN, 784) > 0.5)
+                    .astype(np.float32))
+    state, losses = epoch(state, x)
+    np.asarray(losses)  # warm/compile outside the trace
+
+    def train_once():
+        s, l2 = epoch(state, x)
+        np.asarray(l2)
+
+    xe = jnp.asarray((np.random.RandomState(1).rand(EVAL_N, 784) > 0.5)
+                     .astype(np.float32)).reshape(EVAL_N // BATCH, BATCH, 784)
+    ekey = jax.random.PRNGKey(1)
+    np.asarray(dataset_scalars(state.params, cfg, ekey, xe, K, EVAL_K,
+                               EVAL_CHUNK))  # warm
+
+    def eval_once():
+        np.asarray(dataset_scalars(state.params, cfg, ekey, xe, K, EVAL_K,
+                                   EVAL_CHUNK))
+
+    os.makedirs(ns.summary_dir, exist_ok=True)
+    for tag, fn in (("train", train_once), ("eval", eval_once)):
+        logdir = _capture(tag, ns.out, fn)
+        summary = _summarize(logdir)
+        path = os.path.join(ns.summary_dir, f"{tag}_op_profile.json")
+        with open(path, "w") as f:
+            json.dump(summary, f, indent=1)
+        prog = summary["programs"][0] if summary["programs"] else {}
+        print(f"{tag}: device {prog.get('device_time_ms')} ms, "
+              f"xla-visible flop-util {prog.get('flop_util_pct_of_peak')}% "
+              f"-> {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -135,6 +135,9 @@ class TestParallelStatistics:
                     "reconstruction_loss", "LL_pruned"):
             assert np.isfinite(res[key]), key
         assert len(res2["number_of_active_units"]) == CFG.n_stochastic
+        # the eval-RNG version stamp is the PER-DEVICE chunk actually used:
+        # nll_k=32 over sp=2 -> 16 per device, clamped chunk ask 8 -> 8
+        assert res["nll_chunk"] == 8.0
 
         res_s, _ = ev.training_statistics(
             params, CFG, jax.random.PRNGKey(4), x_test, k=8,
