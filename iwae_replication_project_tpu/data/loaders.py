@@ -182,7 +182,22 @@ def _synthetic(name: str, n_train: int = 1024, n_test: int = 256,
 # Public registry
 # ---------------------------------------------------------------------------
 
-DATASETS = ("binarized_mnist", "mnist", "fashion_mnist", "omniglot", "digits")
+DATASETS = ("binarized_mnist", "mnist", "fashion_mnist", "omniglot", "digits",
+            "digits_gray")
+
+
+def _digits_gray_arrays() -> Tuple[np.ndarray, np.ndarray]:
+    """sklearn's bundled UCI optdigits as 28x28 grayscale intensities in
+    [0, 1]: nearest-neighbor upsample 8x8 -> 32x32, center-crop to 28x28
+    (the same geometry prep `digits` uses before its fixed draw)."""
+    from sklearn.datasets import load_digits as _sk_load_digits
+
+    d = _sk_load_digits()
+    gray = d.images.astype(np.float32) / 16.0  # [1797, 8, 8] in [0, 1]
+    up = np.repeat(np.repeat(gray, 4, axis=1), 4, axis=2)  # [N, 32, 32]
+    up = up[:, 2:30, 2:30].reshape(-1, X_DIM)  # center-crop -> [N, 784]
+    n_train = 1500
+    return up[:n_train], up[n_train:]
 
 
 def _load_sklearn_digits(seed: int = 0) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -191,22 +206,18 @@ def _load_sklearn_digits(seed: int = 0) -> Tuple[np.ndarray, np.ndarray, np.ndar
     available in this zero-egress environment.
 
     Prepared to mirror the fixed-binarization MNIST protocol (PDF §3.1):
-    nearest-neighbor upsample 8x8 -> 32x32, center-crop to 28x28, then ONE
-    deterministic Bernoulli binarization (Larochelle-style fixed draw).
-    Returns ``(x_train_bin, x_test_bin, raw_train_means)`` — the raw grayscale
-    means feed the bias init, reproducing the reference's raw-means-for-
-    fixed-bin policy (flexible_IWAE.py:150-155).
+    grayscale prep (:func:`_digits_gray_arrays`), then ONE deterministic
+    Bernoulli binarization (Larochelle-style fixed draw). Returns
+    ``(x_train_bin, x_test_bin, raw_train_means)`` — the raw grayscale means
+    feed the bias init, reproducing the reference's raw-means-for-fixed-bin
+    policy (flexible_IWAE.py:150-155).
     """
-    from sklearn.datasets import load_digits as _sk_load_digits
-
-    d = _sk_load_digits()
-    gray = d.images.astype(np.float32) / 16.0  # [1797, 8, 8] in [0, 1]
-    up = np.repeat(np.repeat(gray, 4, axis=1), 4, axis=2)  # [N, 32, 32]
-    up = up[:, 2:30, 2:30].reshape(-1, X_DIM)  # center-crop -> [N, 784]
+    gray_train, gray_test = _digits_gray_arrays()
+    up = np.concatenate([gray_train, gray_test])
     rs = np.random.RandomState(seed)
     binary = (rs.uniform(size=up.shape) < up).astype(np.float32)
-    n_train = 1500
-    return binary[:n_train], binary[n_train:], up[:n_train].mean(axis=0)
+    n_train = len(gray_train)
+    return binary[:n_train], binary[n_train:], gray_train.mean(axis=0)
 
 _MNIST_TRAIN = ["train-images-idx3-ubyte", "train-images-idx3-ubyte.gz"]
 _MNIST_TEST = ["t10k-images-idx3-ubyte", "t10k-images-idx3-ubyte.gz"]
@@ -252,11 +263,18 @@ def load_dataset(name: str, data_dir: str = "data", allow_synthetic: bool = True
     elif name == "omniglot":
         pair = _load_omniglot_mat(data_dir) or _load_npz(data_dir, ["omniglot.npz"])
         binarization = "stochastic"
-    else:  # digits: bundled with scikit-learn, needs no data_dir
+    elif name == "digits":  # bundled with scikit-learn, needs no data_dir
         xtr, xte, raw_means = _load_sklearn_digits()
         pair = (xtr, xte)
         bias_means = raw_means
         binarization = "none"
+    else:  # digits_gray: the same real images under the PDF Table 2 protocol
+        # (grayscale intensities kept; per-epoch stochastic re-binarization
+        # on device, like the reference's "mnist"/"omniglot" datasets —
+        # flexible_IWAE.py:147-175). Bias comes from the grayscale train
+        # means, which for this dataset ARE the raw means.
+        pair = _digits_gray_arrays()
+        binarization = "stochastic"
 
     # The fixed-binarization bias policy is a known tenths-of-nats NLL lever
     # (flexible_IWAE.py:150-155): silently substituting binarized-train means

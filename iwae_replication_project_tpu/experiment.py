@@ -137,7 +137,7 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     x_test = ds.x_test[:eval_subset] if eval_subset else ds.x_test
     results_history = []
 
-    for stage, lr, passes in burda_stages(cfg.n_stages):
+    for stage, lr, passes in burda_stages(cfg.n_stages, cfg.passes_scale):
         if stage < start_stage:
             continue
         if logger is None:
@@ -227,7 +227,7 @@ def _run_experiment_torch(cfg: ExperimentConfig,
     x_test = ds.x_test[:eval_subset] if eval_subset else ds.x_test
     results_history = []
     step_count = 0
-    for stage, lr, passes in burda_stages(cfg.n_stages):
+    for stage, lr, passes in burda_stages(cfg.n_stages, cfg.passes_scale):
         mdl.set_learning_rate(lr)
         for _ in range(passes):
             for bi, batch in enumerate(epoch_batches(

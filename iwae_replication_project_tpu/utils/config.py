@@ -21,7 +21,8 @@ SCIENCE_FIELDS = (
     "dataset", "n_hidden_encoder", "n_hidden_decoder",
     "n_latent_encoder", "n_latent_decoder", "loss_function", "k", "p",
     "alpha", "beta", "k2", "batch_size", "adam_eps",
-    "seed", "switch_stage", "switch_loss", "switch_k", "likelihood")
+    "seed", "switch_stage", "switch_loss", "switch_k", "likelihood",
+    "passes_scale")
 
 
 @dataclasses.dataclass
@@ -50,6 +51,13 @@ class ExperimentConfig:
     n_stages: int = 8
     adam_eps: float = 1e-4
     seed: int = 0
+    # Burda-schedule length multiplier: stage i trains
+    # max(1, round(3^(i-1) * passes_scale)) passes. 1.0 = the paper's 3280-pass
+    # schedule (tuned for 50k-image MNIST). Small datasets overfit under it
+    # (digits, 1.5k images, peaks around stage 5-6 — RESULTS.md §2); a
+    # proportional scale keeps the geometric LR/passes structure while
+    # matching total optimization to dataset size.
+    passes_scale: float = 1.0
 
     # objective switching (PDF Table 10, p.13): from `switch_stage` on, train
     # with `switch_loss` (and `switch_k` if given) instead of `loss_function`.
@@ -181,6 +189,8 @@ def build_argparser() -> argparse.ArgumentParser:
     ap.add_argument("--beta", default=None, type=float)
     ap.add_argument("--batch-size", dest="batch_size", default=None, type=int)
     ap.add_argument("--n-stages", dest="n_stages", default=None, type=int)
+    ap.add_argument("--passes-scale", dest="passes_scale", default=None,
+                    type=float)
     ap.add_argument("--seed", default=None, type=int)
     ap.add_argument("--backend", default=None, type=str)
     ap.add_argument("--mesh-dp", dest="mesh_dp", default=None, type=int)

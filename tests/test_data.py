@@ -156,6 +156,26 @@ class TestRealFormatLoaders:
         assert not np.allclose(ds.bias_means, ds.x_train.mean(0))
         assert ds.bias_source == "raw"
 
+    def test_digits_gray_is_real_stochastic_protocol(self, tmp_path):
+        """digits_gray: the same real optdigits images with grayscale
+        intensities kept and the per-epoch stochastic-binarization policy
+        (PDF Table 2 protocol on real data, VERDICT r3 Missing #5)."""
+        ds = load_dataset("digits_gray", data_dir=str(tmp_path))
+        assert not ds.synthetic
+        assert ds.binarization == "stochastic"
+        assert ds.x_train.shape == (1500, 784)
+        # genuinely grayscale: the stochastic path must see values in (0,1),
+        # else per-epoch bernoulli(p) degenerates to the identity
+        interior = (ds.x_train > 0.05) & (ds.x_train < 0.95)
+        assert interior.mean() > 0.05
+        # same underlying images as `digits`: the fixed-bin draw of `digits`
+        # has pixel means close to these intensities
+        fixed = load_dataset("digits", data_dir=str(tmp_path))
+        np.testing.assert_allclose(ds.x_train.mean(), fixed.x_train.mean(),
+                                   atol=0.02)
+        # bias = grayscale train means (the raw means for this dataset)
+        np.testing.assert_allclose(ds.bias_means, ds.x_train.mean(0))
+
     def test_synthetic_fallback_never_claims_raw_bias(self, tmp_path):
         """Raw MNIST idx/npz present but NO .amat pair -> synthetic blobs are
         substituted; the raw means must NOT leak into the bias init (metrics

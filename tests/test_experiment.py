@@ -142,6 +142,18 @@ class TestRunExperiment:
         for (ra, _), (rb, _) in zip(hist_mesh_block, hist_mesh_single):
             assert abs(ra["NLL"] - rb["NLL"]) < 1e-3, (ra["NLL"], rb["NLL"])
 
+    def test_passes_scale_shrinks_schedule(self, tmp_path):
+        """passes_scale proportionally shrinks the Burda schedule (min 1 pass
+        per stage), and is a science field (distinct run identity)."""
+        cfg = tiny_config(tmp_path, n_stages=3, passes_scale=0.5,
+                          save_figures=False)
+        assert cfg.run_name() != tiny_config(tmp_path, n_stages=3).run_name()
+        state, history = run_experiment(cfg, max_batches_per_pass=2,
+                                        eval_subset=16)
+        # stages run 1, round(3*0.5)=2, round(9*0.5)=4 passes of 1 batch each
+        # (synthetic train set 1024 >= 2 batches of 32 -> 2 steps per pass)
+        assert int(state.step) == (1 + 2 + 4) * 2
+
     def test_jsonl_schema(self, tmp_path):
         cfg = tiny_config(tmp_path, n_stages=1)
         run_experiment(cfg, max_batches_per_pass=1, eval_subset=32)
