@@ -9,11 +9,11 @@ implementation underneath is the functional TPU-native core, selected by a
 
 * ``backend="jax"``  — jit/SPMD execution (default). Accepts an optional
   device mesh for data/sample parallelism.
-* ``backend="torch"``— eager CPU oracle with the same semantics, standing in
-  for the reference's eager-TF2 path (TF is not in this environment); used for
+* ``backend="torch"``— eager CPU oracle with the same semantics; used for
   cross-backend parity tests and as the CPU-eager baseline in bench.py.
-* ``backend="tf2"``  — gated: constructing it raises with guidance unless
-  TensorFlow is importable (it is not baked into this image).
+* ``backend="tf2"``  — the reference's own eager-TF2 execution style
+  (backends/tf2_ref.py, TFP-free); raises with guidance when TensorFlow is
+  not importable.
 
 Ctor signature order follows the reference (flexible_IWAE.py:178-180):
 ``(..., dataset_bias, loss_function, k, p, alpha, beta)``.
@@ -46,9 +46,8 @@ class FlexibleModel:
                     "backend='tf2' requires TensorFlow, which is not installed "
                     "in this environment. Use backend='jax' (TPU) or "
                     "backend='torch' (eager CPU oracle).") from e
-            raise NotImplementedError(
-                "backend='tf2' is a compatibility shim pending a TF install; "
-                "use backend='jax' or backend='torch'.")
+            from iwae_replication_project_tpu.backends.tf2_ref import TF2FlexibleModel
+            return super().__new__(TF2FlexibleModel)
         raise ValueError(f"unknown backend {backend!r}; choose jax|torch|tf2")
 
     def __init__(self, n_hidden_encoder: Sequence[int],

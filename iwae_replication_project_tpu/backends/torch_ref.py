@@ -1,8 +1,10 @@
 """Eager CPU oracle backend (torch) with the same semantics as the JAX path.
 
-Role (TF2 is not installed in this image, so torch stands in for the
-reference's eager-TF2 execution style, cf. flexible_IWAE.py:220's commented-out
-@tf.function):
+Role (an independent THIRD implementation — backends/tf2_ref.py restores the
+reference's own eager-TF2 execution style, cf. flexible_IWAE.py:220's
+commented-out @tf.function; this torch oracle shares no framework with either
+the JAX path or the TF2 path, which is what makes its parity checks
+meaningful):
 
 1. an independent implementation for cross-backend parity tests — same
    architecture, same clamps (prob clamp 1e-6/1e-7, std floor 1e-6), same

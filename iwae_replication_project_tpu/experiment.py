@@ -45,10 +45,10 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     `max_batches_per_pass` / `eval_subset` exist for smoke tests and CI — the
     full run is 3280 passes (PDF §3.4).
     """
-    if cfg.backend == "torch":
-        return _run_experiment_torch(cfg, max_batches_per_pass, eval_subset)
+    if cfg.backend in ("torch", "tf2"):
+        return _run_experiment_eager(cfg, max_batches_per_pass, eval_subset)
     if cfg.backend != "jax":
-        # "tf2" and anything else: let the facade produce the canonical error
+        # anything else: let the facade produce the canonical error
         from iwae_replication_project_tpu.api import FlexibleModel
         FlexibleModel([1], [1], [1], [1], backend=cfg.backend)
         raise AssertionError("unreachable")
@@ -204,15 +204,14 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     return state, results_history
 
 
-def _run_experiment_torch(cfg: ExperimentConfig,
+def _run_experiment_eager(cfg: ExperimentConfig,
                           max_batches_per_pass: Optional[int] = None,
                           eval_subset: Optional[int] = None):
-    """The staged experiment on the eager-CPU oracle backend, with the FULL
+    """The staged experiment on an eager facade backend ("torch" — the CPU
+    oracle — or "tf2" — the reference's own execution style), with the FULL
     evaluation suite (training statistics incl. activity + pruned NLL —
     parity with flexible_IWAE.py:496-526). No checkpoint/resume (the
     reference's eager path had none either)."""
-    import torch
-
     from iwae_replication_project_tpu.api import FlexibleModel
 
     ds = load_dataset(cfg.dataset, data_dir=cfg.data_dir,
@@ -222,8 +221,9 @@ def _run_experiment_torch(cfg: ExperimentConfig,
                         dataset_bias=ds.bias_means,
                         loss_function=cfg.loss_function, k=cfg.k, p=cfg.p,
                         alpha=cfg.alpha, beta=cfg.beta, k2=cfg.k2,
-                        backend="torch", seed=cfg.seed).compile()
-    logger = MetricsLogger(cfg.log_dir, run_name=cfg.run_name() + "-torch")
+                        backend=cfg.backend, seed=cfg.seed).compile()
+    logger = MetricsLogger(cfg.log_dir,
+                           run_name=f"{cfg.run_name()}-{cfg.backend}")
     x_test = ds.x_test[:eval_subset] if eval_subset else ds.x_test
     results_history = []
     step_count = 0
@@ -235,7 +235,7 @@ def _run_experiment_torch(cfg: ExperimentConfig,
                     binarization=ds.binarization)):
                 if max_batches_per_pass is not None and bi >= max_batches_per_pass:
                     break
-                mdl.train_step(torch.from_numpy(batch))
+                mdl.train_step(batch)
                 step_count += 1
         res, res2 = mdl.get_training_statistics(
             x_test, cfg.eval_k,

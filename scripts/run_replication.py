@@ -131,20 +131,25 @@ def seed_study(seeds=(1, 2), n_stages: int = 8, passes_scale: float = 1.0):
     return runs
 
 
-def torch_cross_check(n_stages: int = 5):
-    """Train the same digits config on the independent eager torch oracle and
-    on the JAX path; report both NLL trajectories (cross-backend scientific
-    validation on REAL data; summary in results/torch_cross_check.json)."""
+def torch_cross_check(n_stages: int = 5, loss: str = "IWAE",
+                      eager_backend: str = "torch"):
+    """Train the same digits config on an independent eager backend and on
+    the JAX path; report both NLL trajectories (cross-backend scientific
+    validation on REAL data; summary in results/torch_cross_check.json —
+    ``loss="DReG"`` additionally validates the modified-gradient estimators
+    end-to-end, writing results/torch_cross_check_dreg.json;
+    ``eager_backend="tf2"`` runs the reference's own TF2 execution style,
+    writing results/tf2_cross_check.json)."""
     # own log/ckpt dirs: nll_k/eval knobs are not science fields, so this
     # config's run_name collides with the main suite's digits-1L-IWAE-k5 run —
     # logging into RESULTS_DIR would append to that committed artifact
-    base = dict(dataset="digits", allow_synthetic=False, loss_function="IWAE",
+    base = dict(dataset="digits", allow_synthetic=False, loss_function=loss,
                 k=5, n_stages=n_stages, eval_batch_size=99, nll_k=500,
                 save_figures=False, resume=False,
                 log_dir="results/cross_check",
                 checkpoint_dir="checkpoints/cross_check", **ARCH_1L)
     out = {}
-    for backend in ("jax", "torch"):
+    for backend in ("jax", eager_backend):
         cfg = ExperimentConfig(backend=backend, **base)
         t0 = time.perf_counter()
         _, history = run_experiment(cfg)
@@ -157,12 +162,13 @@ def torch_cross_check(n_stages: int = 5):
         print(f"{backend}: NLL {out[backend]['NLL_by_stage']} "
               f"in {out[backend]['wall_seconds']}s")
     out["final_nll_gap"] = round(out["jax"]["NLL_by_stage"][-1]
-                                 - out["torch"]["NLL_by_stage"][-1], 3)
+                                 - out[eager_backend]["NLL_by_stage"][-1], 3)
     os.makedirs("results", exist_ok=True)
-    with open("results/torch_cross_check.json", "w") as f:
+    fname = (f"results/{eager_backend}_cross_check.json" if loss == "IWAE"
+             else f"results/{eager_backend}_cross_check_{loss.lower()}.json")
+    with open(fname, "w") as f:
         json.dump(out, f, indent=2)
-    print("wrote results/torch_cross_check.json; final NLL gap "
-          f"{out['final_nll_gap']} nats")
+    print(f"wrote {fname}; final NLL gap {out['final_nll_gap']} nats")
 
 
 def main(argv=None):
@@ -181,12 +187,22 @@ def main(argv=None):
                          "lands in results/summary_seeds_scaled.json)")
     ap.add_argument("--torch-check", action="store_true",
                     help="run the torch-oracle cross-backend check on digits")
+    ap.add_argument("--torch-check-loss", default="IWAE",
+                    help="objective for --torch-check (e.g. DReG validates "
+                         "the modified-gradient estimators end-to-end)")
+    ap.add_argument("--tf2-check", action="store_true",
+                    help="run the cross-backend check against the TF2 "
+                         "backend (the reference's own execution style)")
     ns = ap.parse_args(argv)
     if ns.scaled and not ns.seed_study:
         ap.error("--scaled only applies to --seed-study (the main suite is "
                  "the unscaled r3 protocol)")
-    if ns.torch_check:
-        torch_cross_check()
+    if ns.torch_check and ns.tf2_check:
+        ap.error("--torch-check and --tf2-check are separate runs; pass one "
+                 "at a time")
+    if ns.torch_check or ns.tf2_check:
+        torch_cross_check(loss=ns.torch_check_loss,
+                          eager_backend="tf2" if ns.tf2_check else "torch")
         return
 
     n_stages = 3 if ns.quick else 8
