@@ -7,7 +7,6 @@ callables; all math lives in models/, objectives/, evaluation/.
 
 from __future__ import annotations
 
-import pickle
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -23,7 +22,6 @@ from iwae_replication_project_tpu.objectives import (
     bound_from_log_weights,
 )
 from iwae_replication_project_tpu.training import train_step as ts
-from iwae_replication_project_tpu.utils.logging import MetricsLogger
 
 
 class JaxFlexibleModel(FlexibleModel):
@@ -58,7 +56,6 @@ class JaxFlexibleModel(FlexibleModel):
         self.state: Optional[ts.TrainState] = None
         self._step_fn = None
         self._eval_key = jax.random.PRNGKey(self.seed + 1)
-        self._logger: Optional[MetricsLogger] = None
 
     # ------------------------------------------------------------------
     # training surface (reference: compile/fit/train_step)
@@ -280,66 +277,25 @@ class JaxFlexibleModel(FlexibleModel):
     # observability / persistence
     # ------------------------------------------------------------------
 
-    def tensorboard_log(self, res: dict, epoch_n: int = -1,
-                        logdir: str = "runs"):
-        """Write the eval scalars (reference schema, flexible_IWAE.py:529-545)."""
-        if self._logger is None:
-            self._logger = MetricsLogger(logdir, run_name=self._run_name())
-        self._logger.log(res, step=self.epoch if epoch_n == -1 else epoch_n)
+    # tensorboard_log() is shared on the base facade (api.FlexibleModel).
 
-    def _arch_descr(self) -> dict:
-        """The ctor lists — enough to name an architecture in error messages."""
-        return {"n_hidden_encoder": list(self.n_hidden_encoder),
-                "n_hidden_decoder": list(self.n_hidden_decoder),
-                "n_latent_encoder": list(self.n_latent_encoder),
-                "n_latent_decoder": list(self.n_latent_decoder)}
+    # weight I/O lives on the base facade (api.FlexibleModel.save_weights /
+    # load_weights — shared payload + architecture guard); the hooks below
+    # bind it to the compiled train state.
 
-    def save_weights(self, path: str):
+    def _weights_pytree(self):
         self._require_compiled()
-        flat, treedef = jax.tree.flatten(self.params)
-        with open(path if path.endswith(".pkl") else path + ".pkl", "wb") as f:
-            pickle.dump({"arrays": [np.asarray(a) for a in flat],
-                         "treedef": str(treedef),
-                         "arch": self._arch_descr()}, f)
+        return self.params
 
-    def load_weights(self, path: str):
-        """Restore params, refusing structure mismatches: treedef AND every
-        leaf's shape/dtype must match this model (mirrors the Orbax path's
-        config-identity guard, utils/checkpoint.py — a same-leaf-count
-        checkpoint from a different architecture must not silently load
-        transposed/mis-assigned weights; VERDICT r3 Weak #4)."""
-        self._require_compiled()
-        with open(path if path.endswith(".pkl") else path + ".pkl", "rb") as f:
-            payload = pickle.load(f)
-        flat, treedef = jax.tree.flatten(self.params)
-        saved_arch = payload.get("arch", "<unknown: pre-r4 checkpoint>")
-
-        def refuse(why: str):
-            raise ValueError(
-                f"checkpoint architecture mismatch ({why}): checkpoint was "
-                f"saved from {saved_arch}, this model is {self._arch_descr()}")
-
-        if len(flat) != len(payload["arrays"]):
-            refuse(f"{len(payload['arrays'])} leaves vs {len(flat)}")
-        if "treedef" in payload and payload["treedef"] != str(treedef):
-            refuse("parameter tree structure differs")
-        for i, (cur, saved) in enumerate(zip(flat, payload["arrays"])):
-            if tuple(cur.shape) != tuple(saved.shape):
-                refuse(f"leaf {i} shape {saved.shape} vs {tuple(cur.shape)}")
-            if np.dtype(cur.dtype) != np.dtype(saved.dtype):
-                refuse(f"leaf {i} dtype {saved.dtype} vs {cur.dtype}")
+    def _set_weights_pytree(self, tree):
         self.state = self.state._replace(
-            params=jax.tree.unflatten(jax.tree.structure(self.params),
-                                      [jnp.asarray(a) for a in payload["arrays"]]))
+            params=jax.tree.map(jnp.asarray, tree))
 
     # ------------------------------------------------------------------
 
     @property
     def params(self):
         return self.state.params
-
-    def _run_name(self) -> str:
-        return f"{self.loss_function}-{len(self.n_hidden_encoder)}L-k_{self.k}"
 
     def _require_compiled(self):
         if self.state is None:

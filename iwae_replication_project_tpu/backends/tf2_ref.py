@@ -37,9 +37,9 @@ _STD_FLOOR = 1e-6
 _LOG_2PI = float(np.log(2.0 * np.pi))
 
 
-def _tf():
-    import tensorflow as tf
-    return tf
+import tensorflow as tf  # the facade only imports this module after
+# confirming TF is importable (api.FlexibleModel.__new__); a missing TF still
+# surfaces as a clean ImportError here.
 
 
 class TF2FlexibleModel(FlexibleModel):
@@ -48,7 +48,6 @@ class TF2FlexibleModel(FlexibleModel):
         # accept (and ignore) the jax-backend execution kwargs so callers can
         # flip backend= without changing anything else; unknown kwargs raise
         super().__init__(*args, **kwargs)
-        tf = _tf()
         # seed BOTH streams: the Generator drives weight init; the global
         # op-level seed drives every tf.random.normal sampling call (same
         # whole-process semantics as torch_ref's torch.manual_seed)
@@ -127,16 +126,25 @@ class TF2FlexibleModel(FlexibleModel):
             d["b"].assign(np.asarray(node["b"], np.float32))
         return self
 
+    def _weights_pytree(self):
+        """Weights in the JAX layout (kernels are already [in, out]) — feeds
+        the shared api.FlexibleModel.save_weights/load_weights payload."""
+        from iwae_replication_project_tpu.api import assemble_jax_tree
+        return assemble_jax_tree(
+            (path, {"w": d["w"].numpy(), "b": d["b"].numpy()})
+            for d, path in self._iter_dense_tree())
+
+    def _set_weights_pytree(self, tree):
+        self.load_jax_params(tree)
+
     # ------------------------------------------------------------------
     # model math (parity constants of flexible_IWAE.py:75,102)
     # ------------------------------------------------------------------
 
     def _dense(self, d, x):
-        tf = _tf()
         return tf.linalg.matmul(x, d["w"]) + d["b"]
 
     def _block(self, blk, x):
-        tf = _tf()
         y = tf.tanh(self._dense(blk["l1"], x))
         y = tf.tanh(self._dense(blk["l2"], y))
         mu = self._dense(blk["mu"], y)
@@ -145,12 +153,10 @@ class TF2FlexibleModel(FlexibleModel):
 
     @staticmethod
     def _normal_log_prob(x, mu, std):
-        tf = _tf()
         z = (x - mu) / std
         return -0.5 * z * z - tf.math.log(std) - 0.5 * _LOG_2PI
 
     def _encode(self, x, k: int, stop_q_score: bool = False):
-        tf = _tf()
         sg = tf.stop_gradient if stop_q_score else (lambda t: t)
         mu, std = self._block(self.enc[0], x)
         h1 = mu + std * tf.random.normal((k,) + tuple(mu.shape))
@@ -167,14 +173,12 @@ class TF2FlexibleModel(FlexibleModel):
         return h, log_q, q_last
 
     def _decode_probs(self, h1):
-        tf = _tf()
         y = tf.tanh(self._dense(self.out["l1"], h1))
         y = tf.tanh(self._dense(self.out["l2"], y))
         probs = tf.sigmoid(self._dense(self.out["out"], y))
         return probs * _PCLAMP_SCALE + _PCLAMP_SHIFT
 
     def _log_weights_aux(self, x, k: int, stop_q_score: bool = False):
-        tf = _tf()
         h, log_q, q_last = self._encode(x, k, stop_q_score=stop_q_score)
         probs = self._decode_probs(h[0])
         log_pxIh = tf.reduce_sum(
@@ -196,14 +200,12 @@ class TF2FlexibleModel(FlexibleModel):
 
     @staticmethod
     def _iwae(log_w):
-        tf = _tf()
         m = tf.stop_gradient(tf.reduce_max(log_w, axis=0, keepdims=True))
         return tf.reduce_mean(
             tf.math.log(tf.reduce_mean(tf.exp(log_w - m), axis=0)) + m[0])
 
     @staticmethod
     def _miwae(log_w, k2: int):
-        tf = _tf()
         k = log_w.shape[0]
         g = tf.reshape(log_w, (k2, k // k2) + tuple(log_w.shape[1:]))
         m = tf.stop_gradient(tf.reduce_max(g, axis=1, keepdims=True))
@@ -211,7 +213,6 @@ class TF2FlexibleModel(FlexibleModel):
             tf.math.log(tf.reduce_mean(tf.exp(g - m), axis=1)) + m[:, 0])
 
     def _bound(self, name, x, k, **over):
-        tf = _tf()
         x = self._flatten(x)
         log_w, aux = self._log_weights_aux(x, k)
         if name == "VAE":
@@ -236,6 +237,11 @@ class TF2FlexibleModel(FlexibleModel):
         if name == "MIWAE":
             return self._miwae(log_w, over.get("k2", self.k2))
         if name == "VAE_V1":
+            if len(self.enc) > 1:
+                raise ValueError(
+                    "VAE_V1's analytic KL is defined for single-stochastic-"
+                    "layer models only (flexible_IWAE.py:433); this model "
+                    f"has {len(self.enc)} stochastic layers")
             mu, std = aux["q_last"]
             kl = tf.reduce_mean(tf.reduce_sum(
                 -0.5 * (1 + 2 * tf.math.log(std) - mu ** 2 - std ** 2), -1))
@@ -272,7 +278,6 @@ class TF2FlexibleModel(FlexibleModel):
     # ------------------------------------------------------------------
 
     def compile(self, optimizer=None, learning_rate: float = 1e-3):
-        tf = _tf()
         self.optimizer = optimizer or tf.keras.optimizers.Adam(
             learning_rate=learning_rate, beta_1=0.9, beta_2=0.999,
             epsilon=1e-4)
@@ -286,7 +291,6 @@ class TF2FlexibleModel(FlexibleModel):
         (same derivation as torch_ref._estimator_value_and_grads). Returns
         ``(bound, variables, grads)`` as parallel lists (tf.Variable is not
         hashable in eager mode, so no dict keying)."""
-        tf = _tf()
         x = self._flatten(x)
         enc_v, rest_v = self._param_groups()
         varlist = enc_v + rest_v
@@ -316,7 +320,6 @@ class TF2FlexibleModel(FlexibleModel):
         raise NotImplementedError(name)
 
     def train_step(self, x) -> Dict[str, float]:
-        tf = _tf()
         if self.optimizer is None:
             raise RuntimeError("call .compile() first")
         if self.loss_function in ("DReG", "STL", "PIWAE"):
@@ -335,30 +338,14 @@ class TF2FlexibleModel(FlexibleModel):
         self.epoch += 1
         return {self.loss_function: float(loss)}
 
-    def fit(self, x_train, epochs: int = 1, batch_size: int = 100,
-            binarization: str = "none", shuffle: bool = True,
-            verbose: bool = False):
-        from iwae_replication_project_tpu.data import epoch_batches
-        x_train = np.asarray(x_train, np.float32).reshape(len(x_train), -1)
-        history = {"loss": []}
-        for e in range(epochs):
-            losses = [self.train_step(b)[self.loss_function]
-                      for b in epoch_batches(x_train, batch_size,
-                                             epoch=self.epoch + e,
-                                             seed=self.seed,
-                                             binarization=binarization,
-                                             shuffle=shuffle)]
-            history["loss"].append(float(np.mean(losses)))
-            if verbose:
-                print(f"epoch {e + 1}/{epochs}: loss={history['loss'][-1]:.4f}")
-        return history
+    # fit() is the shared eager loop on the base facade
+    # (api.FlexibleModel.fit); train_step accepts numpy via _flatten.
 
     # ------------------------------------------------------------------
     # evaluation surface (parity with flexible_IWAE.py:249-302, 466-526)
     # ------------------------------------------------------------------
 
     def _generate_from_top(self, h_top):
-        tf = _tf()
         h = h_top
         for i in range(self.L - 1):
             mu, std = self._block(self.dec[i], h)
@@ -370,12 +357,10 @@ class TF2FlexibleModel(FlexibleModel):
         return self._generate_from_top(h[-1])
 
     def generate(self, n: int):
-        tf = _tf()
         h_top = tf.random.normal((1, n, self.n_latent_encoder[-1]))
         return self._generate_from_top(h_top)[0]
 
     def get_reconstruction_loss(self, x):
-        tf = _tf()
         x = self._flatten(x)
         probs = self.reconstructed_x_probs(x)
         lp = tf.reduce_sum(
@@ -383,12 +368,10 @@ class TF2FlexibleModel(FlexibleModel):
         return -tf.reduce_mean(lp)
 
     def get_E_qhIx_log_pxIh(self, x, n_samples: int):
-        tf = _tf()
         _, aux = self._log_weights_aux(self._flatten(x), n_samples)
         return tf.reduce_mean(aux["log_px_given_h"])
 
     def get_Dkl_qhIx_ph(self, x, k: int):
-        tf = _tf()
         lw, aux = self._log_weights_aux(self._flatten(x), k)
         return tf.reduce_mean(aux["log_px_given_h"]) - tf.reduce_mean(lw)
 
@@ -399,7 +382,6 @@ class TF2FlexibleModel(FlexibleModel):
         """Streaming large-k NLL, online logsumexp in O(chunk) memory."""
         from iwae_replication_project_tpu.evaluation.metrics import (
             largest_divisor_leq)
-        tf = _tf()
         chunk = largest_divisor_leq(k, chunk)
         x = self._flatten(x)
         n = int(x.shape[0])
@@ -413,7 +395,6 @@ class TF2FlexibleModel(FlexibleModel):
         return -tf.reduce_mean(tf.math.log(s / k) + m)
 
     def get_levels_of_units_activity(self, x, n_samples: int, chunk: int = 10):
-        tf = _tf()
         x = self._flatten(x)
         n = int(x.shape[0])
         sums = [tf.zeros((n, d)) for d in self.n_latent_encoder]
@@ -430,7 +411,6 @@ class TF2FlexibleModel(FlexibleModel):
         return variances, eig
 
     def get_eigenvalues_PCA(self, data):
-        tf = _tf()
         data = tf.convert_to_tensor(np.asarray(data), tf.float32)
         centered = data - tf.reduce_mean(data, axis=0)
         cov = tf.linalg.matmul(centered, centered, transpose_a=True) \
@@ -438,7 +418,6 @@ class TF2FlexibleModel(FlexibleModel):
         return tf.linalg.eigvalsh(cov)
 
     def get_active_units(self, variances, eigen_values, threshold: float = 0.01):
-        tf = _tf()
         masks = [tf.cast(v > threshold, tf.float32) for v in variances]
         n_active = [int(tf.reduce_sum(mk)) for mk in masks]
         n_pca = [int(tf.reduce_sum(tf.cast(e > threshold, tf.int32)))
@@ -446,7 +425,6 @@ class TF2FlexibleModel(FlexibleModel):
         return masks, n_active, n_pca
 
     def _masked_log_weights(self, x, masks, k: int):
-        tf = _tf()
         mu, std = self._block(self.enc[0], x)
         h1 = (mu + std * tf.random.normal((k,) + tuple(mu.shape))) * masks[0]
         log_q = tf.reduce_sum(self._normal_log_prob(h1, mu, std), -1)
@@ -472,7 +450,6 @@ class TF2FlexibleModel(FlexibleModel):
                                        chunk: int = 250):
         from iwae_replication_project_tpu.evaluation.metrics import (
             largest_divisor_leq)
-        tf = _tf()
         x = self._flatten(x)
         variances, eig = self.get_levels_of_units_activity(x, activity_samples)
         masks, _, _ = self.get_active_units(variances, eig, threshold)
@@ -496,7 +473,6 @@ class TF2FlexibleModel(FlexibleModel):
         (flexible_IWAE.py:496-526)."""
         from iwae_replication_project_tpu.evaluation.metrics import (
             largest_divisor_leq)
-        tf = _tf()
         x = self._flatten(x)
         n = int(x.shape[0])
         batch_size = largest_divisor_leq(n, batch_size)
@@ -533,18 +509,9 @@ class TF2FlexibleModel(FlexibleModel):
                 nll_chunk))
         return acc, res2
 
-    def tensorboard_log(self, res: dict, epoch_n: int = -1,
-                        logdir: str = "runs"):
-        """The reference logs via tf.summary (flexible_IWAE.py:529-545); this
-        framework's dependency-free writer emits the same wire format."""
-        from iwae_replication_project_tpu.utils.logging import MetricsLogger
-        if getattr(self, "_logger", None) is None:
-            self._logger = MetricsLogger(
-                logdir, run_name=f"{self.loss_function}-{self.L}L-k_{self.k}")
-        self._logger.log(res, step=self.epoch if epoch_n == -1 else epoch_n)
+    # tensorboard_log() is shared on the base facade (api.FlexibleModel).
 
     @staticmethod
     def _flatten(x):
-        tf = _tf()
         x = tf.convert_to_tensor(np.asarray(x, np.float32))
         return tf.reshape(x, (x.shape[0], -1))

@@ -184,6 +184,11 @@ class TorchFlexibleModel(FlexibleModel):
         if name == "MIWAE":
             return self._miwae(log_w, over.get("k2", self.k2))
         if name == "VAE_V1":
+            if len(self.enc) > 1:
+                raise ValueError(
+                    "VAE_V1's analytic KL is defined for single-stochastic-"
+                    "layer models only (flexible_IWAE.py:433); this model "
+                    f"has {len(self.enc)} stochastic layers")
             mu, std = aux["q_last"]
             kl = (-0.5 * (1 + 2 * torch.log(std) - mu ** 2 - std ** 2)).sum(-1).mean()
             return aux["log_px_given_h"].mean() - kl
@@ -266,18 +271,25 @@ class TorchFlexibleModel(FlexibleModel):
         both backends differentiate the same realized reparameterization."""
         bound, grads = self._estimator_value_and_grads(x, name, k, k2=k2,
                                                        h_fixed=h_fixed)
-        tree = {"enc": [{} for _ in self.enc], "dec": [{} for _ in self.dec],
-                "out": {}}
-        for linear, path in self._iter_linear_tree():
-            leaf = {"w": np.asarray(grads[linear.weight].detach()).T.copy(),
-                    "b": np.asarray(grads[linear.bias].detach()).copy()}
-            node = tree[path[0]]
-            for pkey in path[1:-1]:
-                node = node[pkey]
-            node[path[-1]] = leaf
-        tree["enc"] = tuple(tree["enc"])
-        tree["dec"] = tuple(tree["dec"])
-        return float(bound), tree
+        return float(bound), self._jax_tree(
+            lambda lin: {"w": np.asarray(grads[lin.weight].detach()).T.copy(),
+                         "b": np.asarray(grads[lin.bias].detach()).copy()})
+
+    def _jax_tree(self, leaf_fn):
+        """Pytree in the models/iwae.init_params layout from one ``{"w","b"}``
+        leaf per Linear (``w`` already transposed to ``[in, out]`` by
+        `leaf_fn`)."""
+        from iwae_replication_project_tpu.api import assemble_jax_tree
+        return assemble_jax_tree((path, leaf_fn(lin))
+                                 for lin, path in self._iter_linear_tree())
+
+    def _weights_pytree(self):
+        return self._jax_tree(
+            lambda lin: {"w": np.asarray(lin.weight.detach()).T.copy(),
+                         "b": np.asarray(lin.bias.detach()).copy()})
+
+    def _set_weights_pytree(self, tree):
+        self.load_jax_params(tree)
 
     def get_L(self, x, k: int = 5000):
         return self._bound("VAE", x, k)
@@ -322,22 +334,8 @@ class TorchFlexibleModel(FlexibleModel):
         self.epoch += 1
         return {self.loss_function: float(loss.detach())}
 
-    def fit(self, x_train, epochs: int = 1, batch_size: int = 100,
-            binarization: str = "none", shuffle: bool = True,
-            verbose: bool = False):
-        from iwae_replication_project_tpu.data import epoch_batches
-        x_train = np.asarray(x_train, np.float32).reshape(len(x_train), -1)
-        history = {"loss": []}
-        for e in range(epochs):
-            losses = [self.train_step(torch.from_numpy(b))[self.loss_function]
-                      for b in epoch_batches(x_train, batch_size,
-                                             epoch=self.epoch + e, seed=self.seed,
-                                             binarization=binarization,
-                                             shuffle=shuffle)]
-            history["loss"].append(float(np.mean(losses)))
-            if verbose:
-                print(f"epoch {e + 1}/{epochs}: loss={history['loss'][-1]:.4f}")
-        return history
+    # fit() is the shared eager loop on the base facade
+    # (api.FlexibleModel.fit); train_step accepts numpy via _flatten.
 
     # ------------------------------------------------------------------
     # evaluation surface (parity with flexible_IWAE.py:249-302, 466-526)

@@ -187,9 +187,10 @@ def main(argv=None):
                          "lands in results/summary_seeds_scaled.json)")
     ap.add_argument("--torch-check", action="store_true",
                     help="run the torch-oracle cross-backend check on digits")
-    ap.add_argument("--torch-check-loss", default="IWAE",
-                    help="objective for --torch-check (e.g. DReG validates "
-                         "the modified-gradient estimators end-to-end)")
+    ap.add_argument("--check-loss", default=None,
+                    help="objective for --torch-check / --tf2-check (e.g. "
+                         "DReG validates the modified-gradient estimators "
+                         "end-to-end); default IWAE")
     ap.add_argument("--tf2-check", action="store_true",
                     help="run the cross-backend check against the TF2 "
                          "backend (the reference's own execution style)")
@@ -200,8 +201,10 @@ def main(argv=None):
     if ns.torch_check and ns.tf2_check:
         ap.error("--torch-check and --tf2-check are separate runs; pass one "
                  "at a time")
+    if ns.check_loss and not (ns.torch_check or ns.tf2_check):
+        ap.error("--check-loss only applies to --torch-check / --tf2-check")
     if ns.torch_check or ns.tf2_check:
-        torch_cross_check(loss=ns.torch_check_loss,
+        torch_cross_check(loss=ns.check_loss or "IWAE",
                           eager_backend="tf2" if ns.tf2_check else "torch")
         return
 
