@@ -291,29 +291,35 @@ class TorchFlexibleModel(FlexibleModel):
     def _set_weights_pytree(self, tree):
         self.load_jax_params(tree)
 
+    def _eval_bound(self, name, x, k, **over):
+        """Public bound getters are evaluation surface — no autograd graph
+        (and no `float(requires_grad tensor)` warnings downstream)."""
+        with torch.no_grad():
+            return self._bound(name, x, k, **over)
+
     def get_L(self, x, k: int = 5000):
-        return self._bound("VAE", x, k)
+        return self._eval_bound("VAE", x, k)
 
     def get_L_k(self, x, k: int):
-        return self._bound("IWAE", x, k)
+        return self._eval_bound("IWAE", x, k)
 
     def get_L_V1(self, x, n_samples: int):
-        return self._bound("VAE_V1", x, n_samples)
+        return self._eval_bound("VAE_V1", x, n_samples)
 
     def get_L_alpha(self, x, n_samples: int, alpha: float):
-        return self._bound("L_alpha", x, n_samples, alpha=alpha)
+        return self._eval_bound("L_alpha", x, n_samples, alpha=alpha)
 
     def get_L_power_p(self, x, k: int, p: float):
-        return self._bound("L_power_p", x, k, p=p)
+        return self._eval_bound("L_power_p", x, k, p=p)
 
     def get_L_median(self, x, k: int):
-        return self._bound("L_median", x, k)
+        return self._eval_bound("L_median", x, k)
 
     def get_L_CIWAE(self, x, n_samples: int, beta: float):
-        return self._bound("CIWAE", x, n_samples, beta=beta)
+        return self._eval_bound("CIWAE", x, n_samples, beta=beta)
 
     def get_L_MIWAE(self, x, k1: int, k2: int):
-        return self._bound("MIWAE", x, k1 * k2, k2=k2)
+        return self._eval_bound("MIWAE", x, k1 * k2, k2=k2)
 
     def train_step(self, x) -> Dict[str, float]:
         if self.optimizer is None:

@@ -1,6 +1,7 @@
 from iwae_replication_project_tpu.data.loaders import (
     DATASETS,
     Dataset,
+    digits_labels,
     load_dataset,
     output_bias_from_pixel_means,
 )
@@ -12,6 +13,7 @@ from iwae_replication_project_tpu.data.pipeline import (
 __all__ = [
     "DATASETS",
     "Dataset",
+    "digits_labels",
     "load_dataset",
     "output_bias_from_pixel_means",
     "epoch_batches",

@@ -186,6 +186,11 @@ DATASETS = ("binarized_mnist", "mnist", "fashion_mnist", "omniglot", "digits",
             "digits_gray")
 
 
+#: train/test split point of the 1797 sklearn digits — shared by the image
+#: arrays and digits_labels so the two can never drift apart
+_DIGITS_N_TRAIN = 1500
+
+
 def _digits_gray_arrays() -> Tuple[np.ndarray, np.ndarray]:
     """sklearn's bundled UCI optdigits as 28x28 grayscale intensities in
     [0, 1]: nearest-neighbor upsample 8x8 -> 32x32, center-crop to 28x28
@@ -196,8 +201,7 @@ def _digits_gray_arrays() -> Tuple[np.ndarray, np.ndarray]:
     gray = d.images.astype(np.float32) / 16.0  # [1797, 8, 8] in [0, 1]
     up = np.repeat(np.repeat(gray, 4, axis=1), 4, axis=2)  # [N, 32, 32]
     up = up[:, 2:30, 2:30].reshape(-1, X_DIM)  # center-crop -> [N, 784]
-    n_train = 1500
-    return up[:n_train], up[n_train:]
+    return up[:_DIGITS_N_TRAIN], up[_DIGITS_N_TRAIN:]
 
 
 def _load_sklearn_digits(seed: int = 0) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -218,6 +222,17 @@ def _load_sklearn_digits(seed: int = 0) -> Tuple[np.ndarray, np.ndarray, np.ndar
     binary = (rs.uniform(size=up.shape) < up).astype(np.float32)
     n_train = len(gray_train)
     return binary[:n_train], binary[n_train:], gray_train.mean(axis=0)
+
+def digits_labels() -> Tuple[np.ndarray, np.ndarray]:
+    """Class labels aligned with the `digits`/`digits_gray` train/test split
+    (same first-1500/rest ordering as :func:`_digits_gray_arrays`) — for the
+    latent-space figures (utils/viz.latent_scatter; the reference report's
+    qualitative latent visualizations, PDF pp.16-17)."""
+    from sklearn.datasets import load_digits as _sk_load_digits
+
+    y = _sk_load_digits().target.astype(np.int64)
+    return y[:_DIGITS_N_TRAIN], y[_DIGITS_N_TRAIN:]
+
 
 _MNIST_TRAIN = ["train-images-idx3-ubyte", "train-images-idx3-ubyte.gz"]
 _MNIST_TEST = ["t10k-images-idx3-ubyte", "t10k-images-idx3-ubyte.gz"]

@@ -80,3 +80,46 @@ def save_stage_figures(params, cfg, key: jax.Array, x_test: np.ndarray,
         save_png(image_grid(arr, ncols=ncols, img_hw=img_hw), p)
         paths.append(p)
     return paths
+
+
+def latent_scatter(params, cfg, key: jax.Array, x: np.ndarray, path: str,
+                   labels: Optional[np.ndarray] = None, layer: int = -1,
+                   n_samples: int = 64) -> np.ndarray:
+    """Posterior-mean scatter of one stochastic layer projected onto its top-2
+    principal components — the reference report's qualitative latent-space
+    view (PDF pp.16-17; the PCA machinery mirrors flexible_IWAE.py:284-291).
+
+    ``labels`` (optional, e.g. data.digits_labels()) colors the points by
+    class. Returns the ``[B, 2]`` projection; writes a PNG to ``path``.
+    """
+    from iwae_replication_project_tpu.models import iwae as model
+
+    x = jnp.asarray(np.asarray(x, np.float32).reshape(len(x), -1))
+    h, _, _ = model.encode(params, cfg, key, x, n_samples)
+    means = np.asarray(jnp.mean(h[layer], axis=0))  # MC E_q[h | x], [B, d]
+    centered = means - means.mean(axis=0)
+    cov = centered.T @ centered / len(centered)
+    _, vecs = np.linalg.eigh(cov)
+    proj = centered @ vecs[:, -2:][:, ::-1]  # [B, 2], PC1 first
+
+    # object-oriented figure: no pyplot, so the process-global matplotlib
+    # backend (e.g. an interactive one in a notebook) is left untouched
+    from matplotlib.figure import Figure
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fig = Figure(figsize=(5, 5), dpi=120)
+    ax = fig.add_subplot()
+    if labels is None:
+        ax.scatter(proj[:, 0], proj[:, 1], s=8, alpha=0.7)
+    else:
+        sc = ax.scatter(proj[:, 0], proj[:, 1], s=8, alpha=0.8,
+                        c=np.asarray(labels), cmap="tab10")
+        fig.colorbar(sc, ax=ax, ticks=np.unique(np.asarray(labels)),
+                     fraction=0.046)
+    ax.set_xlabel("PC 1")
+    ax.set_ylabel("PC 2")
+    layer_n = layer if layer >= 0 else len(cfg.n_latent_enc) + layer
+    ax.set_title(f"posterior means, stochastic layer {layer_n + 1}")
+    fig.tight_layout()
+    fig.savefig(path)
+    return proj

@@ -176,6 +176,28 @@ class TestRunExperiment:
         img = Image.open(os.path.join(fig_dir, "stage_01_samples.png"))
         assert img.size[0] > 28 and img.size[1] > 28
 
+    def test_latent_scatter_written(self, tmp_path):
+        """The latent-space figure (reference report pp.16-17): posterior-mean
+        PCA scatter, labels aligned with the digits split."""
+        import jax
+        from iwae_replication_project_tpu.data import digits_labels, load_dataset
+        from iwae_replication_project_tpu.models.iwae import (
+            ModelConfig, init_params)
+        from iwae_replication_project_tpu.utils.viz import latent_scatter
+
+        ds = load_dataset("digits")
+        y_train, y_test = digits_labels()
+        assert len(y_train) == len(ds.x_train)
+        assert len(y_test) == len(ds.x_test)
+        cfg = ModelConfig(n_hidden_enc=(16,), n_hidden_dec=(16,),
+                          n_latent_enc=(8,), n_latent_dec=(784,))
+        params = init_params(jax.random.key(0), cfg)
+        path = str(tmp_path / "latent.png")
+        proj = latent_scatter(params, cfg, jax.random.key(1), ds.x_test[:64],
+                              path, labels=y_test[:64], n_samples=16)
+        assert proj.shape == (64, 2)
+        assert os.path.getsize(path) > 0
+
 
 def _write_amat_fixture(data_dir, n_train=64, n_test=32, with_raw=True, seed=11):
     """Fixture dataset in the reference's own formats: Larochelle `.amat`
