@@ -7,6 +7,7 @@ from iwae_replication_project_tpu.parallel.dp import (
     distributed_logmeanexp,
 )
 from iwae_replication_project_tpu.parallel.auto import make_pjit_train_step
+from iwae_replication_project_tpu.parallel import multihost
 
 __all__ = [
     "make_mesh",
@@ -17,4 +18,5 @@ __all__ = [
     "shard_batch",
     "distributed_logmeanexp",
     "make_pjit_train_step",
+    "multihost",
 ]

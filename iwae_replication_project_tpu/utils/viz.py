@@ -97,6 +97,10 @@ def latent_scatter(params, cfg, key: jax.Array, x: np.ndarray, path: str,
     x = jnp.asarray(np.asarray(x, np.float32).reshape(len(x), -1))
     h, _, _ = model.encode(params, cfg, key, x, n_samples)
     means = np.asarray(jnp.mean(h[layer], axis=0))  # MC E_q[h | x], [B, d]
+    if means.shape[1] < 2:
+        raise ValueError(
+            f"latent_scatter needs a >=2-dim stochastic layer to project; "
+            f"layer {layer} has dimension {means.shape[1]}")
     centered = means - means.mean(axis=0)
     cov = centered.T @ centered / len(centered)
     _, vecs = np.linalg.eigh(cov)

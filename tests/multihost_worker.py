@@ -1,0 +1,80 @@
+"""Worker process for tests/test_multihost.py (NOT a test module).
+
+Each worker owns 4 virtual CPU devices (XLA_FLAGS set by the parent), joins a
+two-process jax.distributed cluster, builds the framework's (dp=4, sp=2)
+process-spanning mesh, and runs
+
+1. one whole-epoch compiled scan (replicated data path), and
+2. one SPMD train step fed through multihost.host_local_batch_to_global with
+   ONLY this process's half of the batch (the true multi-host data path),
+
+then prints one JSON line the parent compares across processes and against
+its own single-process 8-device run.
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port>
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    proc_id, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+    import jax
+    # the axon TPU plugin overrides JAX_PLATFORMS at import time; force CPU
+    # via the config API before any backend initialization (same trick as
+    # tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+
+    from iwae_replication_project_tpu.parallel import make_mesh, multihost
+
+    multihost.initialize(coordinator_address=f"127.0.0.1:{port}",
+                         num_processes=nprocs, process_id=proc_id)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from iwae_replication_project_tpu.models import ModelConfig
+    from iwae_replication_project_tpu.objectives import ObjectiveSpec
+    from iwae_replication_project_tpu.parallel import (
+        make_parallel_epoch_fn, make_parallel_train_step)
+    from iwae_replication_project_tpu.parallel.dp import replicate
+    from iwae_replication_project_tpu.training import create_train_state
+
+    info = multihost.process_info()
+    cfg = ModelConfig(n_hidden_enc=(16, 8), n_latent_enc=(6, 3),
+                      n_hidden_dec=(8, 16), n_latent_dec=(6, 12), x_dim=12)
+    mesh = make_mesh(dp=4, sp=2)
+    spec = ObjectiveSpec("IWAE", k=8)
+    state0 = create_train_state(jax.random.PRNGKey(0), cfg)
+    x = (jax.random.uniform(jax.random.PRNGKey(42), (32, 12)) > 0.5
+         ).astype(jnp.float32)
+
+    # 1. whole-epoch scan, replicated data (every host holds the full set)
+    epoch = make_parallel_epoch_fn(spec, cfg, mesh, n_train=32, batch_size=16,
+                                   donate=False)
+    s1, losses = epoch(replicate(mesh, state0), replicate(mesh, x))
+    losses = multihost.fetch(losses)
+    leafsum = float(sum(np.abs(l).sum()
+                        for l in jax.tree.leaves(multihost.fetch(s1.params))))
+
+    # 2. one SPMD step fed host-locally: this process contributes ONLY its
+    # contiguous half of the 16-row batch
+    batch = np.asarray(x[:16])
+    rows_per_proc = batch.shape[0] // nprocs
+    local = batch[proc_id * rows_per_proc:(proc_id + 1) * rows_per_proc]
+    x_global = multihost.host_local_batch_to_global(local, mesh)
+    step = make_parallel_train_step(spec, cfg, mesh, donate=False,
+                                    batch_size=16)
+    _, metrics = step(replicate(mesh, state0), x_global)
+    step_loss = float(multihost.fetch(metrics["loss"]))
+
+    print(json.dumps({"proc": proc_id, "info": info,
+                      "epoch_losses": np.asarray(losses).tolist(),
+                      "leafsum": round(leafsum, 6),
+                      "step_loss": step_loss}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

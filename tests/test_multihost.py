@@ -1,0 +1,118 @@
+"""Multi-host validation: the framework's SPMD programs over a mesh that
+spans OS processes (SURVEY.md §2.5's distributed-communication row — the
+multi-host layer on top of the fake-8-device single-process tests).
+
+Two subprocesses with 4 virtual CPU devices each join a jax.distributed
+cluster (tests/multihost_worker.py), build the same (dp=4, sp=2) mesh shape
+the single-process suite uses, and run the whole-epoch scan plus a train
+step fed host-locally through multihost.host_local_batch_to_global. Their
+results must agree with each other AND with this (single-process,
+8-device) run of the identical program.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu.models import ModelConfig
+from iwae_replication_project_tpu.objectives import ObjectiveSpec
+from iwae_replication_project_tpu.parallel import (
+    make_mesh,
+    make_parallel_epoch_fn,
+    make_parallel_train_step,
+    multihost,
+    shard_batch,
+)
+from iwae_replication_project_tpu.parallel.dp import replicate
+from iwae_replication_project_tpu.training import create_train_state
+
+CFG2 = ModelConfig(n_hidden_enc=(16, 8), n_latent_enc=(6, 3),
+                   n_hidden_dec=(8, 16), n_latent_dec=(6, 12), x_dim=12)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _single_process_reference():
+    """The same program the workers run, on this process's 8-device mesh."""
+    mesh = make_mesh(dp=4, sp=2)
+    spec = ObjectiveSpec("IWAE", k=8)
+    state0 = create_train_state(jax.random.PRNGKey(0), CFG2)
+    x = (jax.random.uniform(jax.random.PRNGKey(42), (32, 12)) > 0.5
+         ).astype(jnp.float32)
+
+    epoch = make_parallel_epoch_fn(spec, CFG2, mesh, n_train=32,
+                                   batch_size=16, donate=False)
+    s1, losses = epoch(replicate(mesh, state0), replicate(mesh, x))
+    leafsum = float(sum(np.abs(np.asarray(l)).sum()
+                        for l in jax.tree.leaves(s1.params)))
+
+    step = make_parallel_train_step(spec, CFG2, mesh, donate=False,
+                                    batch_size=16)
+    _, metrics = step(replicate(mesh, state0), shard_batch(mesh, x[:16]))
+    return np.asarray(losses), leafsum, float(metrics["loss"])
+
+
+@pytest.mark.slow
+def test_two_process_cluster_matches_single_process(devices, tmp_path):
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    # workers must not inherit this process's compilation-cache dir lock
+    env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "mh_cache")
+
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(i), "2", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for p in procs:  # no orphans on timeout / assertion failure
+            if p.poll() is None:
+                p.kill()
+
+    # the cluster actually formed: 2 processes x 4 devices = 8 global
+    for o in outs:
+        assert o["info"]["process_count"] == 2
+        assert o["info"]["local_device_count"] == 4
+        assert o["info"]["global_device_count"] == 8
+
+    # both processes computed identical results
+    assert outs[0]["epoch_losses"] == outs[1]["epoch_losses"]
+    assert outs[0]["leafsum"] == outs[1]["leafsum"]
+    assert outs[0]["step_loss"] == outs[1]["step_loss"]
+
+    # ... and they match the single-process run of the same program
+    ref_losses, ref_leafsum, ref_step_loss = _single_process_reference()
+    np.testing.assert_allclose(outs[0]["epoch_losses"], ref_losses, rtol=1e-6)
+    np.testing.assert_allclose(outs[0]["leafsum"], ref_leafsum, rtol=1e-5)
+    np.testing.assert_allclose(outs[0]["step_loss"], ref_step_loss, rtol=1e-6)
+
+
+def test_fetch_and_info_single_process(devices):
+    """multihost.fetch / process_info degrade gracefully in-process."""
+    info = multihost.process_info()
+    assert info["process_count"] == 1
+    assert info["global_device_count"] == 8
+    tree = {"a": jnp.ones((3,)), "b": 2.5}
+    out = multihost.fetch(tree)
+    np.testing.assert_array_equal(out["a"], np.ones((3,)))
+    assert out["b"] == 2.5
