@@ -53,6 +53,21 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         FlexibleModel([1], [1], [1], [1], backend=cfg.backend)
         raise AssertionError("unreachable")
 
+    is_primary = True
+    if cfg.multihost:
+        # join the jax.distributed cluster BEFORE the first device
+        # computation (jax.distributed refuses once a backend exists);
+        # afterwards jax.devices() spans every process, so the mesh below
+        # does too. Only the primary process writes artifacts — except
+        # checkpoints, which Orbax coordinates across hosts itself.
+        from iwae_replication_project_tpu.parallel import multihost
+        multihost.initialize(coordinator_address=cfg.coordinator,
+                             num_processes=cfg.num_processes,
+                             process_id=cfg.process_id)
+        info = multihost.process_info()
+        print(f"multihost: {info}")
+        is_primary = info["process_index"] == 0
+
     ds = load_dataset(cfg.dataset, data_dir=cfg.data_dir,
                       allow_synthetic=cfg.allow_synthetic)
     model_cfg = cfg.model_config()
@@ -67,7 +82,10 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     x_train_dev = jax.numpy.asarray(ds.x_train[:n_train].reshape(n_train, -1))
 
     mesh = None
-    if cfg.mesh_dp is not None or cfg.mesh_sp > 1:
+    if cfg.multihost or cfg.mesh_dp is not None or cfg.mesh_sp > 1:
+        # under --multihost the mesh is mandatory (mesh_dp=None spans all
+        # global devices) — otherwise each process would silently train its
+        # own duplicate single-device copy
         from iwae_replication_project_tpu.parallel import make_mesh
         from iwae_replication_project_tpu.parallel.dp import replicate
         mesh = make_mesh(dp=cfg.mesh_dp, sp=cfg.mesh_sp)
@@ -140,7 +158,7 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     for stage, lr, passes in burda_stages(cfg.n_stages, cfg.passes_scale):
         if stage < start_stage:
             continue
-        if logger is None:
+        if logger is None and is_primary:
             logger = MetricsLogger(cfg.log_dir, run_name=cfg.run_name())
         state = set_learning_rate(state, lr)
         active_spec = cfg.objective_spec(stage)
@@ -183,21 +201,25 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         # `res` already carries "nll_chunk" — the EFFECTIVE chunk the eval
         # driver used (clamped per device under sp) — as the eval-RNG version
         print({k: round(v, 4) for k, v in res.items() if isinstance(v, float)})
-        logger.log(res, step=int(state.step))
+        from iwae_replication_project_tpu.parallel.multihost import fetch
+        step_n = int(fetch(state.step))
         results_history.append((res, {
             "number_of_active_units": res2["number_of_active_units"],
             "number_of_PCA_active_units": res2["number_of_PCA_active_units"]}))
+        if logger is not None:  # primary process only under --multihost
+            logger.log(res, step=step_n)
+            if cfg.save_figures:
+                from iwae_replication_project_tpu.utils.viz import (
+                    save_stage_figures)
+                save_stage_figures(state.params, model_cfg,
+                                   jax.random.fold_in(eval_key, 10_000 + stage),
+                                   x_test, logger.dir, stage)
+            with open(os.path.join(logger.dir, "results.pkl"), "wb") as f:
+                pickle.dump(results_history, f)
 
-        if cfg.save_figures:
-            from iwae_replication_project_tpu.utils.viz import save_stage_figures
-            save_stage_figures(state.params, model_cfg,
-                               jax.random.fold_in(eval_key, 10_000 + stage),
-                               x_test, logger.dir, stage)
-
-        save_checkpoint(ckpt_dir, int(state.step), state, stage,
+        # every process participates: Orbax coordinates multi-host saves
+        save_checkpoint(ckpt_dir, step_n, state, stage,
                         config_json=cfg.to_json(), keep=cfg.checkpoint_keep)
-        with open(os.path.join(logger.dir, "results.pkl"), "wb") as f:
-            pickle.dump(results_history, f)
 
     if logger is not None:
         logger.close()

@@ -336,7 +336,12 @@ def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
     batches = x_test.reshape(n_batches, batch_size, -1)
     batches = jax.device_put(batches, NamedSharding(mesh, P(None, AXES.dp)))
 
-    scalars = np.asarray(scalars_fn(params, key, batches))
+    # conversions go through multihost.fetch: under a process-spanning mesh
+    # the replicated outputs are not fully addressable and plain np.asarray
+    # raises; in single-process runs fetch is equivalent to np.asarray
+    from iwae_replication_project_tpu.parallel.multihost import fetch
+
+    scalars = np.asarray(fetch(scalars_fn(params, key, batches)))
     acc = {name: float(v) for name, v in zip(SCALAR_NAMES, scalars)}
     # the per-DEVICE chunk actually used (clamped against nll_k/sp inside
     # make_parallel_dataset_scalars) — the eval-RNG version stamp
@@ -344,7 +349,7 @@ def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
 
     res2: Dict[str, object] = {}
     k_au, k_pruned = jax.random.split(jax.random.fold_in(key, n_batches))
-    means = means_fn(params, k_au, jnp.asarray(x_test.reshape(n, -1)))
+    means = fetch(means_fn(params, k_au, jnp.asarray(x_test.reshape(n, -1))))
     variances = tuple(jnp.var(m, axis=0) for m in means)
     eigvals = tuple(au.pca_eigenvalues(m) for m in means)
     masks, n_active, n_active_pca = au.active_units(variances, eigvals,
@@ -357,6 +362,7 @@ def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
     if include_pruned_nll:
         pruned_fn = make_parallel_pruned_nll(cfg, mesh, nll_k, nll_chunk,
                                              n_layers=cfg.n_stochastic)
-        acc["LL_pruned"] = float(pruned_fn(params, k_pruned,
-                                           jnp.asarray(batches[0]), *masks))
+        acc["LL_pruned"] = float(fetch(pruned_fn(params, k_pruned,
+                                                 jnp.asarray(batches[0]),
+                                                 *masks)))
     return acc, res2

@@ -81,6 +81,14 @@ class ExperimentConfig:
     backend: str = "jax"          # "jax" | "torch" (eager CPU oracle) | "tf2" (gated)
     mesh_dp: Optional[int] = None  # None -> all devices
     mesh_sp: int = 1
+    # join a jax.distributed cluster before any device computation
+    # (multi-host jobs; parallel/multihost.py). coordinator/num_processes/
+    # process_id stay None on TPU pods (auto-detected); set all three
+    # explicitly elsewhere (e.g. "host:1234", 2, rank).
+    multihost: bool = False
+    coordinator: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
     compute_dtype: Optional[str] = None  # None | "bfloat16"
     # "logits" is the exact Bernoulli log-likelihood x*l - softplus(l) — the
     # fast path bench.py measures, and the default since round 3 (NLL-
@@ -195,6 +203,16 @@ def build_argparser() -> argparse.ArgumentParser:
     ap.add_argument("--backend", default=None, type=str)
     ap.add_argument("--mesh-dp", dest="mesh_dp", default=None, type=int)
     ap.add_argument("--mesh-sp", dest="mesh_sp", default=None, type=int)
+    ap.add_argument("--multihost", dest="multihost", default=None,
+                    action="store_true",
+                    help="join the jax.distributed cluster before building "
+                         "the mesh (TPU pods: coordinator auto-detected)")
+    ap.add_argument("--coordinator", default=None, type=str,
+                    help="jax.distributed coordinator host:port (omit on "
+                         "TPU pods)")
+    ap.add_argument("--num-processes", dest="num_processes", default=None,
+                    type=int)
+    ap.add_argument("--process-id", dest="process_id", default=None, type=int)
     ap.add_argument("--compute-dtype", dest="compute_dtype", default=None, type=str)
     ap.add_argument("--likelihood", default=None, type=str)
     ap.add_argument("--fused-likelihood", dest="fused_likelihood",

@@ -62,12 +62,17 @@ def save_stage_figures(params, cfg, key: jax.Array, x_test: np.ndarray,
         img_hw = (side, cfg.x_dim // side)
     k_gen, k_rec = jax.random.split(key)
 
+    # fetch: replicated outputs under a process-spanning mesh are not fully
+    # addressable (plain np.asarray raises); single-process it is equivalent
+    from iwae_replication_project_tpu.parallel.multihost import fetch
+
     h_top = jax.random.normal(k_gen, (1, n_samples, cfg.n_latent_enc[-1]))
-    gen = np.asarray(model.generate_x(params, cfg, jax.random.fold_in(k_gen, 1),
-                                      h_top)[0])
+    gen = np.asarray(fetch(model.generate_x(params, cfg,
+                                            jax.random.fold_in(k_gen, 1),
+                                            h_top)[0]))
 
     x = jnp.asarray(x_test[:n_recon].reshape(n_recon, -1), jnp.float32)
-    rec = np.asarray(model.reconstruct_probs(params, cfg, k_rec, x)[0])
+    rec = np.asarray(fetch(model.reconstruct_probs(params, cfg, k_rec, x)[0]))
     # interleave original / reconstruction column pairs
     paired = np.empty((2 * n_recon, cfg.x_dim), dtype=np.float32)
     paired[0::2] = np.asarray(x)

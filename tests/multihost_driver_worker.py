@@ -1,0 +1,22 @@
+"""Driver worker for tests/test_multihost.py (NOT a test module).
+
+Runs the REAL production entry point (experiment.main → run_experiment) in a
+multi-process cluster member. The only test-specific line is forcing the CPU
+platform before the first backend use (the axon TPU plugin overrides
+JAX_PLATFORMS at import time — same trick as tests/conftest.py); everything
+else, including jax.distributed initialization, flows through the driver's
+own --multihost path.
+
+Usage: python multihost_driver_worker.py <experiment CLI args...>
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from iwae_replication_project_tpu.experiment import main  # noqa: E402
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -70,10 +70,26 @@ def main() -> None:
     _, metrics = step(replicate(mesh, state0), x_global)
     step_loss = float(multihost.fetch(metrics["loss"]))
 
+    # 3. the fused sharded evaluation suite (streaming NLL psum, median
+    # all_gather, ...) over the process-spanning mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from iwae_replication_project_tpu.parallel.eval import (
+        make_parallel_dataset_scalars)
+    from iwae_replication_project_tpu.parallel.mesh import AXES
+
+    scal_fn = make_parallel_dataset_scalars(cfg, mesh, k=8, nll_k=16,
+                                            nll_chunk=8)
+    batches = jax.device_put(jnp.asarray(np.asarray(x).reshape(2, 16, 12)),
+                             NamedSharding(mesh, P(None, AXES.dp)))
+    scalars = np.asarray(multihost.fetch(
+        scal_fn(s1.params, jax.random.PRNGKey(3), batches)))
+
     print(json.dumps({"proc": proc_id, "info": info,
                       "epoch_losses": np.asarray(losses).tolist(),
                       "leafsum": round(leafsum, 6),
-                      "step_loss": step_loss}), flush=True)
+                      "step_loss": step_loss,
+                      "eval_scalars": scalars.tolist()}), flush=True)
 
 
 if __name__ == "__main__":

@@ -72,6 +72,10 @@ class TestConfig:
         cfg = config_from_args([])
         assert cfg == ExperimentConfig()
 
+    def test_cli_multihost_flag(self):
+        assert config_from_args(["--multihost"]).multihost is True
+        assert config_from_args([]).multihost is False
+
 
 class TestRunExperiment:
     @pytest.mark.slow

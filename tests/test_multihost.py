@@ -60,7 +60,19 @@ def _single_process_reference():
     step = make_parallel_train_step(spec, CFG2, mesh, donate=False,
                                     batch_size=16)
     _, metrics = step(replicate(mesh, state0), shard_batch(mesh, x[:16]))
-    return np.asarray(losses), leafsum, float(metrics["loss"])
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from iwae_replication_project_tpu.parallel.eval import (
+        make_parallel_dataset_scalars)
+    from iwae_replication_project_tpu.parallel.mesh import AXES
+
+    scal_fn = make_parallel_dataset_scalars(CFG2, mesh, k=8, nll_k=16,
+                                            nll_chunk=8)
+    batches = jax.device_put(x.reshape(2, 16, 12),
+                             NamedSharding(mesh, P(None, AXES.dp)))
+    scalars = np.asarray(scal_fn(s1.params, jax.random.PRNGKey(3), batches))
+    return np.asarray(losses), leafsum, float(metrics["loss"]), scalars
 
 
 @pytest.mark.slow
@@ -99,12 +111,86 @@ def test_two_process_cluster_matches_single_process(devices, tmp_path):
     assert outs[0]["epoch_losses"] == outs[1]["epoch_losses"]
     assert outs[0]["leafsum"] == outs[1]["leafsum"]
     assert outs[0]["step_loss"] == outs[1]["step_loss"]
+    assert outs[0]["eval_scalars"] == outs[1]["eval_scalars"]
 
     # ... and they match the single-process run of the same program
-    ref_losses, ref_leafsum, ref_step_loss = _single_process_reference()
+    (ref_losses, ref_leafsum, ref_step_loss,
+     ref_scalars) = _single_process_reference()
     np.testing.assert_allclose(outs[0]["epoch_losses"], ref_losses, rtol=1e-6)
     np.testing.assert_allclose(outs[0]["leafsum"], ref_leafsum, rtol=1e-5)
     np.testing.assert_allclose(outs[0]["step_loss"], ref_step_loss, rtol=1e-6)
+    np.testing.assert_allclose(outs[0]["eval_scalars"], ref_scalars,
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_two_process_driver_run(devices, tmp_path):
+    """The PRODUCTION driver end-to-end under --multihost: two processes run
+    `experiment.main` against one shared config; the cluster forms inside
+    run_experiment, the mesh defaults to all 8 global devices, only the
+    primary writes metrics/figures/results, checkpoints are Orbax-coordinated,
+    and the logged numbers match a single-process mesh run of the same
+    config."""
+    from iwae_replication_project_tpu.experiment import run_experiment
+    from iwae_replication_project_tpu.utils.config import ExperimentConfig
+
+    shared = dict(
+        dataset="binarized_mnist", data_dir=str(tmp_path / "data"),
+        n_hidden_encoder=(16,), n_hidden_decoder=(16,),
+        n_latent_encoder=(4,), n_latent_decoder=(784,),
+        loss_function="IWAE", k=4, batch_size=32, n_stages=2,
+        eval_k=4, nll_k=8, nll_chunk=4, eval_batch_size=16,
+        activity_samples=8, save_figures=False,
+    )
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(ExperimentConfig(**shared).to_json())
+
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_driver_worker.py")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "mh_cache")
+
+    def argv(i):
+        return [sys.executable, worker, "--config", str(cfg_path),
+                "--multihost", "--coordinator", f"127.0.0.1:{port}",
+                "--num-processes", "2", "--process-id", str(i),
+                "--log-dir", str(tmp_path / "runs"),
+                "--checkpoint-dir", str(tmp_path / "ckpt")]
+
+    procs = [subprocess.Popen(argv(i), stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True, env=env)
+             for i in range(2)]
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, f"driver worker failed:\n{out}\n{err}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    # exactly ONE process wrote the run artifacts
+    runs_dir = tmp_path / "runs"
+    run_dirs = sorted(os.listdir(runs_dir))
+    assert len(run_dirs) == 1, run_dirs
+    metrics_path = runs_dir / run_dirs[0] / "metrics.jsonl"
+    rows = [json.loads(l) for l in metrics_path.read_text().splitlines()]
+    assert [r["stage"] for r in rows] == [1, 2]
+    assert os.path.exists(runs_dir / run_dirs[0] / "results.pkl")
+
+    # the logged numbers match a single-process run of the same mesh shape
+    ref_cfg = ExperimentConfig(**shared, mesh_dp=8,
+                               log_dir=str(tmp_path / "ref_runs"),
+                               checkpoint_dir=str(tmp_path / "ref_ckpt"))
+    _, ref_hist = run_experiment(ref_cfg)
+    for row, (ref_res, _) in zip(rows, ref_hist):
+        for key in ("VAE", "IWAE", "NLL"):
+            np.testing.assert_allclose(row[key], ref_res[key], rtol=1e-4,
+                                       atol=1e-5)
 
 
 def test_fetch_and_info_single_process(devices):
