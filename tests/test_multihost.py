@@ -154,24 +154,31 @@ def test_two_process_driver_run(devices, tmp_path):
                          + os.pathsep + env.get("PYTHONPATH", ""))
     env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "mh_cache")
 
-    def argv(i):
+    def argv(i, coord_port, extra=()):
         return [sys.executable, worker, "--config", str(cfg_path),
-                "--multihost", "--coordinator", f"127.0.0.1:{port}",
+                "--multihost", "--coordinator", f"127.0.0.1:{coord_port}",
                 "--num-processes", "2", "--process-id", str(i),
                 "--log-dir", str(tmp_path / "runs"),
-                "--checkpoint-dir", str(tmp_path / "ckpt")]
+                "--checkpoint-dir", str(tmp_path / "ckpt")] + list(extra)
 
-    procs = [subprocess.Popen(argv(i), stdout=subprocess.PIPE,
-                              stderr=subprocess.PIPE, text=True, env=env)
-             for i in range(2)]
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=420)
-            assert p.returncode == 0, f"driver worker failed:\n{out}\n{err}"
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+    def run_pair(coord_port, extra=()):
+        procs = [subprocess.Popen(argv(i, coord_port, extra),
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.PIPE, text=True, env=env)
+                 for i in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=420)
+                assert p.returncode == 0, f"driver worker failed:\n{out}\n{err}"
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        return outs
+
+    run_pair(port)
 
     # exactly ONE process wrote the run artifacts
     runs_dir = tmp_path / "runs"
@@ -191,6 +198,13 @@ def test_two_process_driver_run(devices, tmp_path):
         for key in ("VAE", "IWAE", "NLL"):
             np.testing.assert_allclose(row[key], ref_res[key], rtol=1e-4,
                                        atol=1e-5)
+
+    # multi-host RESUME: a second cluster run with one more stage restores
+    # the Orbax checkpoint written by the first and continues at stage 3
+    outs = run_pair(_free_port(), extra=["--n-stages", "3"])
+    assert "resumed from checkpoint; continuing at stage 3" in outs[0]
+    rows = [json.loads(l) for l in metrics_path.read_text().splitlines()]
+    assert rows[-1]["stage"] == 3
 
 
 def test_fetch_and_info_single_process(devices):
