@@ -140,7 +140,8 @@ def test_two_process_driver_run(devices, tmp_path):
         n_latent_encoder=(4,), n_latent_decoder=(784,),
         loss_function="IWAE", k=4, batch_size=32, n_stages=2,
         eval_k=4, nll_k=8, nll_chunk=4, eval_batch_size=16,
-        activity_samples=8, save_figures=False,
+        activity_samples=8, save_figures=True,  # exercises viz fetch on the
+        # process-spanning mesh (primary-only)
     )
     cfg_path = tmp_path / "cfg.json"
     cfg_path.write_text(ExperimentConfig(**shared).to_json())
@@ -188,6 +189,8 @@ def test_two_process_driver_run(devices, tmp_path):
     rows = [json.loads(l) for l in metrics_path.read_text().splitlines()]
     assert [r["stage"] for r in rows] == [1, 2]
     assert os.path.exists(runs_dir / run_dirs[0] / "results.pkl")
+    assert os.path.exists(runs_dir / run_dirs[0] / "figures"
+                          / "stage_01_samples.png")
 
     # the logged numbers match a single-process run of the same mesh shape
     ref_cfg = ExperimentConfig(**shared, mesh_dp=8,
