@@ -85,11 +85,27 @@ def main() -> None:
     scalars = np.asarray(multihost.fetch(
         scal_fn(s1.params, jax.random.PRNGKey(3), batches)))
 
+    # 4. same suite on a mesh whose sp PAIRS CROSS the process boundary
+    # (device order transposed: each sp group holds one device from each
+    # process), so the distributed logmeanexp's pmax/psum run over the
+    # inter-host link. Results must be placement-independent.
+    cross_devs = np.asarray(jax.devices()).reshape(nprocs, -1).T.reshape(-1)
+    mesh_x = make_mesh(dp=4, sp=2, devices=list(cross_devs))
+    scal_x = make_parallel_dataset_scalars(cfg, mesh_x, k=8, nll_k=16,
+                                           nll_chunk=8)
+    batches_x = jax.device_put(jnp.asarray(np.asarray(x).reshape(2, 16, 12)),
+                               NamedSharding(mesh_x, P(None, AXES.dp)))
+    params_x = jax.device_put(s1.params, NamedSharding(mesh_x, P()))
+    scalars_x = np.asarray(multihost.fetch(
+        scal_x(params_x, jax.random.PRNGKey(3), batches_x)))
+
     print(json.dumps({"proc": proc_id, "info": info,
                       "epoch_losses": np.asarray(losses).tolist(),
                       "leafsum": round(leafsum, 6),
                       "step_loss": step_loss,
-                      "eval_scalars": scalars.tolist()}), flush=True)
+                      "eval_scalars": scalars.tolist(),
+                      "eval_scalars_cross_sp": scalars_x.tolist()}),
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -112,6 +112,13 @@ def test_two_process_cluster_matches_single_process(devices, tmp_path):
     assert outs[0]["leafsum"] == outs[1]["leafsum"]
     assert outs[0]["step_loss"] == outs[1]["step_loss"]
     assert outs[0]["eval_scalars"] == outs[1]["eval_scalars"]
+    assert (outs[0]["eval_scalars_cross_sp"]
+            == outs[1]["eval_scalars_cross_sp"])
+    # collectives are placement-independent: the mesh whose sp pairs CROSS
+    # the process boundary gives the same scalars (up to reduction-order
+    # rounding) as the process-local-sp mesh
+    np.testing.assert_allclose(outs[0]["eval_scalars_cross_sp"],
+                               outs[0]["eval_scalars"], rtol=1e-5, atol=1e-6)
 
     # ... and they match the single-process run of the same program
     (ref_losses, ref_leafsum, ref_step_loss,
