@@ -153,6 +153,12 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     logger = None
     eval_key = jax.random.PRNGKey(cfg.seed + 10_000)
     x_test = ds.x_test[:eval_subset] if eval_subset else ds.x_test
+    y_test = None
+    if cfg.save_figures and cfg.dataset in ("digits", "digits_gray"):
+        # labeled dataset -> also the latent-space view per stage
+        # (reference report pp.16-17)
+        from iwae_replication_project_tpu.data import digits_labels
+        y_test = digits_labels()[1][:len(x_test)]
     results_history = []
 
     for stage, lr, passes in burda_stages(cfg.n_stages, cfg.passes_scale):
@@ -214,6 +220,15 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
                 save_stage_figures(state.params, model_cfg,
                                    jax.random.fold_in(eval_key, 10_000 + stage),
                                    x_test, logger.dir, stage)
+                if y_test is not None:
+                    from iwae_replication_project_tpu.utils.viz import (
+                        latent_scatter)
+                    latent_scatter(
+                        state.params, model_cfg,
+                        jax.random.fold_in(eval_key, 20_000 + stage),
+                        x_test, os.path.join(logger.dir, "figures",
+                                             f"stage_{stage:02d}_latent.png"),
+                        labels=y_test)
             with open(os.path.join(logger.dir, "results.pkl"), "wb") as f:
                 pickle.dump(results_history, f)
 

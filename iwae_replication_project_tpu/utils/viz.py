@@ -99,9 +99,11 @@ def latent_scatter(params, cfg, key: jax.Array, x: np.ndarray, path: str,
     """
     from iwae_replication_project_tpu.models import iwae as model
 
+    from iwae_replication_project_tpu.parallel.multihost import fetch
+
     x = jnp.asarray(np.asarray(x, np.float32).reshape(len(x), -1))
     h, _, _ = model.encode(params, cfg, key, x, n_samples)
-    means = np.asarray(jnp.mean(h[layer], axis=0))  # MC E_q[h | x], [B, d]
+    means = np.asarray(fetch(jnp.mean(h[layer], axis=0)))  # E_q[h|x], [B, d]
     if means.shape[1] < 2:
         raise ValueError(
             f"latent_scatter needs a >=2-dim stochastic layer to project; "

@@ -180,6 +180,15 @@ class TestRunExperiment:
         img = Image.open(os.path.join(fig_dir, "stage_01_samples.png"))
         assert img.size[0] > 28 and img.size[1] > 28
 
+    def test_driver_writes_latent_figure_for_digits(self, tmp_path):
+        """On the labeled digits dataset, the staged driver adds the
+        latent-space scatter to each stage's figure set."""
+        cfg = tiny_config(tmp_path, dataset="digits", n_stages=1,
+                          activity_samples=8)
+        run_experiment(cfg, max_batches_per_pass=1, eval_subset=32)
+        fig_dir = os.path.join(cfg.log_dir, cfg.run_name(), "figures")
+        assert os.path.exists(os.path.join(fig_dir, "stage_01_latent.png"))
+
     def test_latent_scatter_written(self, tmp_path):
         """The latent-space figure (reference report pp.16-17): posterior-mean
         PCA scatter, labels aligned with the digits split."""
