@@ -156,16 +156,23 @@ class TF2FlexibleModel(FlexibleModel):
         z = (x - mu) / std
         return -0.5 * z * z - tf.math.log(std) - 0.5 * _LOG_2PI
 
-    def _encode(self, x, k: int, stop_q_score: bool = False):
+    def _encode(self, x, k: int, stop_q_score: bool = False, masks=None):
+        """Encoder pass; `masks` zeroes inactive latent coords after sampling,
+        densities evaluated at the masked values (flexible_IWAE.py:466-494
+        semantics, = evaluation/activity.py)."""
         sg = tf.stop_gradient if stop_q_score else (lambda t: t)
         mu, std = self._block(self.enc[0], x)
         h1 = mu + std * tf.random.normal((k,) + tuple(mu.shape))
+        if masks is not None:
+            h1 = h1 * masks[0]
         log_q = tf.reduce_sum(self._normal_log_prob(h1, sg(mu), sg(std)), -1)
         h = [h1]
         q_last = (mu, std)
         for i in range(1, self.L):
             mu, std = self._block(self.enc[i], h[-1])
             hi = mu + std * tf.random.normal(tf.shape(mu))
+            if masks is not None:
+                hi = hi * masks[i]
             log_q = log_q + tf.reduce_sum(
                 self._normal_log_prob(hi, sg(mu), sg(std)), -1)
             h.append(hi)
@@ -178,8 +185,10 @@ class TF2FlexibleModel(FlexibleModel):
         probs = tf.sigmoid(self._dense(self.out["out"], y))
         return probs * _PCLAMP_SCALE + _PCLAMP_SHIFT
 
-    def _log_weights_aux(self, x, k: int, stop_q_score: bool = False):
-        h, log_q, q_last = self._encode(x, k, stop_q_score=stop_q_score)
+    def _log_weights_aux(self, x, k: int, stop_q_score: bool = False,
+                         masks=None):
+        h, log_q, q_last = self._encode(x, k, stop_q_score=stop_q_score,
+                                        masks=masks)
         probs = self._decode_probs(h[0])
         log_pxIh = tf.reduce_sum(
             x * tf.math.log(probs) + (1 - x) * tf.math.log1p(-probs), -1)
@@ -425,24 +434,7 @@ class TF2FlexibleModel(FlexibleModel):
         return masks, n_active, n_pca
 
     def _masked_log_weights(self, x, masks, k: int):
-        mu, std = self._block(self.enc[0], x)
-        h1 = (mu + std * tf.random.normal((k,) + tuple(mu.shape))) * masks[0]
-        log_q = tf.reduce_sum(self._normal_log_prob(h1, mu, std), -1)
-        h = [h1]
-        for i in range(1, self.L):
-            mu, std = self._block(self.enc[i], h[-1])
-            hi = (mu + std * tf.random.normal(tf.shape(mu))) * masks[i]
-            log_q = log_q + tf.reduce_sum(self._normal_log_prob(hi, mu, std), -1)
-            h.append(hi)
-        probs = self._decode_probs(h[0])
-        log_pxIh = tf.reduce_sum(
-            x * tf.math.log(probs) + (1 - x) * tf.math.log1p(-probs), -1)
-        log_ph = tf.reduce_sum(-0.5 * h[-1] ** 2 - 0.5 * _LOG_2PI, -1)
-        for i in range(self.L - 1):
-            mu, std = self._block(self.dec[i], h[self.L - 1 - i])
-            log_ph = log_ph + tf.reduce_sum(
-                self._normal_log_prob(h[self.L - 2 - i], mu, std), -1)
-        return log_ph + log_pxIh - log_q
+        return self._log_weights_aux(x, k, masks=masks)[0]
 
     def get_NLL_without_inactive_units(self, x, threshold: float = 0.01,
                                        n_samples: int = 5000,

@@ -97,12 +97,16 @@ class TorchFlexibleModel(FlexibleModel):
         for g in self.optimizer.param_groups:
             g["lr"] = lr
 
-    def _encode(self, x, k: int, stop_q_score: bool = False, h_fixed=None):
+    def _encode(self, x, k: int, stop_q_score: bool = False, h_fixed=None,
+                masks=None):
         """Encoder pass. `stop_q_score` detaches the density parameters inside
         log q while keeping the pathwise sample dependence (the score-term
         removal of STL/DReG). `h_fixed` replays given latent values through the
         reparameterization (eps recovered with detached moments) so gradients
-        can be compared against another backend's draw-for-draw.
+        can be compared against another backend's draw-for-draw. `masks`
+        zeroes inactive latent coords after sampling, densities evaluated at
+        the masked values (flexible_IWAE.py:466-494 semantics,
+        = evaluation/activity.py).
         """
         sg = (lambda t: t.detach()) if stop_q_score else (lambda t: t)
 
@@ -120,12 +124,16 @@ class TorchFlexibleModel(FlexibleModel):
 
         mu, std = self.enc[0](x)
         h1 = draw(mu, std, 0, (k,) + mu.shape)
+        if masks is not None:
+            h1 = h1 * masks[0]
         log_q = _normal_log_prob(h1, sg(mu), sg(std)).sum(-1)
         h = [h1]
         q_last = (mu, std)
         for i in range(1, self.L):
             mu, std = self.enc[i](h[-1])
             hi = draw(mu, std, i, mu.shape)
+            if masks is not None:
+                hi = hi * masks[i]
             log_q = log_q + _normal_log_prob(hi, sg(mu), sg(std)).sum(-1)
             h.append(hi)
             q_last = (mu, std)
@@ -136,9 +144,9 @@ class TorchFlexibleModel(FlexibleModel):
         return probs * _PCLAMP_SCALE + _PCLAMP_SHIFT
 
     def _log_weights_aux(self, x, k: int, stop_q_score: bool = False,
-                         h_fixed=None):
+                         h_fixed=None, masks=None):
         h, log_q, q_last = self._encode(x, k, stop_q_score=stop_q_score,
-                                        h_fixed=h_fixed)
+                                        h_fixed=h_fixed, masks=masks)
         probs = self._decode_probs(h[0])
         log_pxIh = (x * torch.log(probs) + (1 - x) * torch.log1p(-probs)).sum(-1)
         log_ph = (-0.5 * h[-1] ** 2 - 0.5 * float(np.log(2 * np.pi))).sum(-1)
@@ -422,24 +430,7 @@ class TorchFlexibleModel(FlexibleModel):
         return masks, n_active, n_pca
 
     def _masked_log_weights(self, x, masks, k: int):
-        """Inactive coords zeroed after sampling, densities at masked values
-        (flexible_IWAE.py:466-494 semantics, = evaluation/activity.py)."""
-        mu, std = self.enc[0](x)
-        h1 = (mu + std * torch.randn((k,) + mu.shape)) * masks[0]
-        log_q = _normal_log_prob(h1, mu, std).sum(-1)
-        h = [h1]
-        for i in range(1, self.L):
-            mu, std = self.enc[i](h[-1])
-            hi = (mu + std * torch.randn(mu.shape)) * masks[i]
-            log_q = log_q + _normal_log_prob(hi, mu, std).sum(-1)
-            h.append(hi)
-        probs = self._decode_probs(h[0])
-        log_pxIh = (x * torch.log(probs) + (1 - x) * torch.log1p(-probs)).sum(-1)
-        log_ph = (-0.5 * h[-1] ** 2 - 0.5 * float(np.log(2 * np.pi))).sum(-1)
-        for i in range(self.L - 1):
-            mu, std = self.dec[i](h[self.L - 1 - i])
-            log_ph = log_ph + _normal_log_prob(h[self.L - 2 - i], mu, std).sum(-1)
-        return log_ph + log_pxIh - log_q
+        return self._log_weights_aux(x, k, masks=masks)[0]
 
     def get_NLL_without_inactive_units(self, x, threshold: float = 0.01,
                                        n_samples: int = 5000,
