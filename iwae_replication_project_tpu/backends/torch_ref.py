@@ -473,7 +473,8 @@ class TorchFlexibleModel(FlexibleModel):
         acc = {"VAE": 0.0, "IWAE": 0.0, "NLL": 0.0,
                "E_q(h|x)[log(p(x|h))]": 0.0, "D_kl(q(h|x),p(h))": 0.0,
                "D_kl(q(h|x),p(h|x))": 0.0, "reconstruction_loss": 0.0,
-               "nll_chunk": float(nll_chunk)}  # eval-RNG version stamp
+               "nll_chunk": float(nll_chunk),
+               "eval_batch": float(batch_size)}  # eval-RNG version stamp
         with torch.no_grad():
             for i in range(n_batches):
                 xb = x[i * batch_size:(i + 1) * batch_size]

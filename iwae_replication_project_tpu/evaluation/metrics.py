@@ -169,10 +169,11 @@ def training_statistics(params, cfg: model.ModelConfig, key: jax.Array,
     scalars = np.asarray(dataset_scalars(params, cfg, key, batches, k,
                                          nll_k, nll_chunk))
     acc = {name: float(v) for name, v in zip(SCALAR_NAMES, scalars)}
-    # the chunk actually used versions the eval RNG stream (it may be clamped
-    # below the configured ask) — stamp it at the source so every caller logs
-    # the true value
+    # the chunk and batch actually used version the eval RNG stream (both may
+    # be clamped below the configured ask) — stamp them at the source so
+    # every caller logs the true values
     acc["nll_chunk"] = float(nll_chunk)
+    acc["eval_batch"] = float(batch_size)
 
     res2: Dict[str, object] = {}
     k_au, k_pruned = jax.random.split(jax.random.fold_in(key, n_batches))

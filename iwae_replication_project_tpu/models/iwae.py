@@ -174,12 +174,17 @@ def log_px_given_h(params: Params, cfg: ModelConfig, x: jax.Array,
     """``log p(x|h)`` summed over pixels -> ``[k, B]`` (flexible_IWAE.py:123-129)."""
     if cfg.fused_likelihood:
         from iwae_replication_project_tpu.ops.fused_likelihood import (
-            fused_bernoulli_ll)
+            fits_vmem, fused_bernoulli_ll)
         out = params["out"]
-        y = jnp.tanh(mlp.dense_apply(out["l1"], h1, cfg.matmul_dtype))
-        y = jnp.tanh(mlp.dense_apply(out["l2"], y, cfg.matmul_dtype))
-        return fused_bernoulli_ll(y, out["out"]["w"], out["out"]["b"], x,
-                                  not _on_tpu())
+        # oversized shapes (e.g. eval batches >= ~400 rows) exceed the
+        # kernel's scoped-VMEM budget — the unfused branch below computes
+        # the identical logits-form likelihood, so fall through silently
+        if fits_vmem(h1.shape[0], h1.shape[1], out["out"]["w"].shape[0],
+                     out["out"]["w"].shape[-1]):
+            y = jnp.tanh(mlp.dense_apply(out["l1"], h1, cfg.matmul_dtype))
+            y = jnp.tanh(mlp.dense_apply(out["l2"], y, cfg.matmul_dtype))
+            return fused_bernoulli_ll(y, out["out"]["w"], out["out"]["b"], x,
+                                      not _on_tpu())
     logits = decode_logits(params, cfg, h1)
     if cfg.likelihood == "clamp":
         probs = dist.clamp_probs(jax.nn.sigmoid(logits))

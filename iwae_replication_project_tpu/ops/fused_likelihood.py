@@ -42,7 +42,36 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-TILE_K = 8    # K-slab height (sublane-aligned)
+TILE_K = 8    # K-slab height (sublane-aligned; Pallas requires multiples of 8)
+_VMEM_BUDGET = 13 * 1024 * 1024  # bytes; the TPU scoped-vmem limit is 16M
+
+
+def fits_vmem(k: int, b: int, hdim: int, n_pixels: int,
+              grad: bool = False) -> bool:
+    """Whether the kernel's per-program VMEM working set fits at TILE_K.
+
+    The K-slab cannot shrink below 8 (TPU sublane rule), so oversized shapes
+    cannot be tiled smaller — they must fall back to the unfused XLA
+    composition instead of failing to compile. Two gates use this:
+
+    * models/iwae.log_px_given_h checks the forward estimate (measured on
+      v5e: batch 300 compiles at ~12.3M est, batch 400 fails at ~16.2M —
+      the 13M budget separates them) and skips the kernel entirely when it
+      cannot fit;
+    * _fused_bwd checks the larger `grad=True` estimate (recomputed logits
+      + x/g rows + dlogits slabs; batch 200 was observed to exceed scoped
+      vmem at 17.7M) and swaps in the XLA backward while keeping the fused
+      forward.
+    """
+    p_pad = _pixel_pad(n_pixels)
+    tk = min(TILE_K, k)
+    if grad:
+        est = (3 * tk * b * p_pad + 2 * tk * b * hdim
+               + 2 * hdim * p_pad + b * p_pad + tk * b + p_pad)
+    else:
+        est = (tk * b * p_pad + tk * b * hdim + hdim * p_pad
+               + b * p_pad + tk * b)
+    return 4 * est <= _VMEM_BUDGET
 
 
 def _pixel_pad(n_pixels: int) -> int:
@@ -189,9 +218,25 @@ def _fused_fwd(h1, w, bias, x, interpret):
     return out, (h1, w, bias, x)
 
 
+def _bwd_reference(h1, w, bias, x, g):
+    """Unfused XLA backward (same math as _bwd_kernel, materialized)."""
+    logits = jnp.einsum("kbh,hd->kbd", h1, w) + bias
+    dlogits = g[..., None] * (x[None] - jax.nn.sigmoid(logits))
+    dh = jnp.einsum("kbd,hd->kbh", dlogits, w)
+    dw = jnp.einsum("kbh,kbd->hd", h1, dlogits)
+    db = jnp.sum(dlogits, axis=(0, 1))
+    return dh, dw, db
+
+
 def _fused_bwd(interpret, res, g):
     h1, w, bias, x = res
-    dh, dw, db = _bwd_pallas(h1, w, bias, x, g, interpret=interpret)
+    k, b, hdim = h1.shape
+    if fits_vmem(k, b, hdim, w.shape[-1], grad=True):
+        dh, dw, db = _bwd_pallas(h1, w, bias, x, g, interpret=interpret)
+    else:
+        # backward working set over scoped-vmem budget (e.g. batch >= ~150):
+        # keep the fused forward, let XLA schedule the backward
+        dh, dw, db = _bwd_reference(h1, w, bias, x, g)
     return dh, dw, db, None  # no gradient for the binary targets
 
 

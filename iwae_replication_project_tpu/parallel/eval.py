@@ -346,6 +346,7 @@ def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
     # the per-DEVICE chunk actually used (clamped against nll_k/sp inside
     # make_parallel_dataset_scalars) — the eval-RNG version stamp
     acc["nll_chunk"] = float(largest_divisor_leq(nll_k // n_sp, nll_chunk))
+    acc["eval_batch"] = float(batch_size)
 
     res2: Dict[str, object] = {}
     k_au, k_pruned = jax.random.split(jax.random.fold_in(key, n_batches))

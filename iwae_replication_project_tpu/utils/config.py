@@ -74,7 +74,13 @@ class ExperimentConfig:
     # computed under; pre-r4 artifacts (chunk 100) carry it in their
     # checkpoint config.json instead.
     nll_chunk: int = 250
-    eval_batch_size: int = 100
+    # 200 since round 4: +22% fused-eval throughput over 100 (measured sweep,
+    # RESULTS.md §4; 250+ regress or exceed the Pallas kernel's VMEM and fall
+    # back to the unfused path). Like nll_chunk, the eval batch versions the
+    # per-batch eval RNG folding — every metrics.jsonl row stamps the
+    # effective `eval_batch`; pre-r4 artifacts ran at 100 (in their
+    # checkpoint config.json).
+    eval_batch_size: int = 200
     activity_samples: int = 1000
 
     # execution

@@ -164,9 +164,12 @@ class TestRunExperiment:
         path = os.path.join(cfg.log_dir, cfg.run_name(), "metrics.jsonl")
         rec = json.loads(open(path).read().strip().splitlines()[-1])
         for key in ("VAE", "IWAE", "NLL", "reconstruction_loss", "step",
-                    "synthetic_data", "raw_means_bias", "nll_chunk"):
+                    "synthetic_data", "raw_means_bias", "nll_chunk",
+                    "eval_batch"):
             assert key in rec, key
-        assert rec["nll_chunk"] == cfg.nll_chunk  # eval-RNG version stamp
+        # eval-RNG version stamps (effective values)
+        assert rec["nll_chunk"] == cfg.nll_chunk
+        assert rec["eval_batch"] == cfg.eval_batch_size
         assert bool(rec["synthetic_data"])  # tiny runs use blob fallback
 
     def test_stage_figures_written(self, tmp_path):
