@@ -46,6 +46,10 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     full run is 3280 passes (PDF §3.4).
     """
     if cfg.backend in ("torch", "tf2"):
+        if cfg.multihost:
+            raise ValueError(
+                "--multihost requires backend='jax' (the eager torch/tf2 "
+                "backends are single-process oracles)")
         return _run_experiment_eager(cfg, max_batches_per_pass, eval_subset)
     if cfg.backend != "jax":
         # anything else: let the facade produce the canonical error

@@ -328,6 +328,11 @@ class TestBackendDispatch:
                                            cfg.run_name() + "-torch",
                                            "metrics.jsonl"))
 
+    def test_multihost_rejected_on_eager_backends(self, tmp_path):
+        cfg = tiny_config(tmp_path, backend="torch", multihost=True)
+        with pytest.raises(ValueError, match="backend='jax'"):
+            run_experiment(cfg)
+
     def test_unknown_backend_raises(self, tmp_path):
         cfg = tiny_config(tmp_path, backend="mxnet")
         with pytest.raises(ValueError):
