@@ -17,10 +17,7 @@ from iwae_replication_project_tpu.api import FlexibleModel
 import iwae_replication_project_tpu.evaluation.activity as au
 import iwae_replication_project_tpu.evaluation.metrics as ev
 from iwae_replication_project_tpu.models import iwae as model
-from iwae_replication_project_tpu.objectives import (
-    ObjectiveSpec,
-    bound_from_log_weights,
-)
+from iwae_replication_project_tpu.objectives import bound_from_log_weights
 from iwae_replication_project_tpu.training import train_step as ts
 
 

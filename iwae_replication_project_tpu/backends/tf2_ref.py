@@ -25,7 +25,7 @@ tests/test_tf2_backend.py (skipped wholesale when TF is not importable).
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 import numpy as np
 

@@ -23,7 +23,7 @@ import gzip
 import os
 import struct
 import zlib
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 

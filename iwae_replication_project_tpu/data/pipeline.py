@@ -15,7 +15,7 @@ Here an epoch is a deterministic generator of device-ready batches:
 
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Iterator
 
 import numpy as np
 

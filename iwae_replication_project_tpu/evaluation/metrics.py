@@ -30,7 +30,6 @@ from iwae_replication_project_tpu.models import iwae as model
 from iwae_replication_project_tpu.objectives import estimators as est
 from iwae_replication_project_tpu.ops import distributions as dist
 from iwae_replication_project_tpu.ops.logsumexp import (
-    logmeanexp,
     online_logsumexp_finalize,
     online_logsumexp_init,
     online_logsumexp_update,

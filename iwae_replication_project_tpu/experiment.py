@@ -18,7 +18,6 @@ import pickle
 from typing import Optional
 
 import jax
-import numpy as np
 
 from iwae_replication_project_tpu.data import load_dataset, epoch_batches
 from iwae_replication_project_tpu.evaluation import metrics as ev
