@@ -62,7 +62,7 @@ beta = 0.05
 # the ctor order is (..., loss_function, k, p, alpha, beta))
 mdl = FlexibleModel(n_hidden_encoder, n_hidden_decoder,
                     n_latent_encoder, n_latent_decoder,
-                    dataset_bias=ds.bias_means,
+                    dataset_bias=None, pixel_means=ds.bias_means,
                     loss_function=loss_function, k=k, p=p, alpha=alpha,
                     beta=beta, backend=args.backend)
 mdl.compile()
@@ -77,7 +77,7 @@ n_stages = 2 if args.smoke else 8
 results_history = []
 eval_k = k
 nll_k = 64 if args.smoke else 5000
-nll_chunk = 32 if args.smoke else 100
+nll_chunk = 32 if args.smoke else 250  # the production default (utils/config.py)
 x_eval = x_test[:100] if args.smoke else x_test
 
 for i, lr, passes in burda_stages(n_stages):

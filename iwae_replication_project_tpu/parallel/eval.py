@@ -313,8 +313,9 @@ def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
     if n % n_dp != 0:
         # dp needs equal batch shards; drop the ragged tail (≤ n_dp-1 points)
         n_use = (n // n_dp) * n_dp
-        print(f"parallel eval: trimming test set {n} -> {n_use} "
-              f"for dp={n_dp} sharding")
+        if jax.process_index() == 0:
+            print(f"parallel eval: trimming test set {n} -> {n_use} "
+                  f"for dp={n_dp} sharding")
         x_test = x_test[:n_use]
         n = n_use
     # batches must split over dp; after the trim n % n_dp == 0, so d = n_dp

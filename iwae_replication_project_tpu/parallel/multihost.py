@@ -77,6 +77,13 @@ def fetch(tree):
     """
     def leaf(a):
         if isinstance(a, jax.Array) and not a.is_fully_addressable:
+            if not a.sharding.is_fully_replicated:
+                raise ValueError(
+                    "fetch() got a non-addressable array that is not fully "
+                    f"replicated (sharding {a.sharding}); returning its local "
+                    "shard would silently truncate the global value. "
+                    "all_gather/psum it inside the program, or use "
+                    "jax.experimental.multihost_utils.process_allgather.")
             return np.asarray(a.addressable_data(0))
         return np.asarray(a) if isinstance(a, jax.Array) else a
 

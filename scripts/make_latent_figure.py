@@ -26,7 +26,7 @@ OUT = "results/figures/latent_digits_iwae1l.png"
 def main(out: str = OUT) -> None:
     ds = load_dataset("digits")
     _, y_test = digits_labels()
-    m = FlexibleModel([200], [200], [50], [784], dataset_bias=ds.bias_means,
+    m = FlexibleModel([200], [200], [50], [784], dataset_bias=None, pixel_means=ds.bias_means,
                       loss_function="IWAE", k=8, backend="jax",
                       seed=0).compile()
     for lr, epochs in ((1e-3, 150), (5e-4, 100), (2e-4, 80)):

@@ -85,10 +85,10 @@ class TestTF2Semantics:
         x = make_x(32, seed=3)
         bias = np.clip(x.mean(0), 0.05, 0.95)
         jm = FlexibleModel(**{k: list(v) for k, v in ARCH.items()},
-                           dataset_bias=bias, loss_function="VAE", k=8,
+                           pixel_means=bias, loss_function="VAE", k=8,
                            backend="jax", seed=0).compile()
         jm.fit(x, epochs=5, batch_size=16)
-        tm = build(dataset_bias=bias, loss_function="VAE", k=8, seed=0).compile()
+        tm = build(pixel_means=bias, loss_function="VAE", k=8, seed=0).compile()
         tm.load_jax_params(jm.params)
 
         jv = np.array([float(jm.get_L(x, 64)) for _ in range(6)])
