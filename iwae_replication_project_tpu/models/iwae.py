@@ -174,17 +174,26 @@ def log_px_given_h(params: Params, cfg: ModelConfig, x: jax.Array,
     """``log p(x|h)`` summed over pixels -> ``[k, B]`` (flexible_IWAE.py:123-129)."""
     if cfg.fused_likelihood:
         from iwae_replication_project_tpu.ops.fused_likelihood import (
-            fits_vmem, fused_bernoulli_ll)
+            fused_bernoulli_ll, kernel_usable)
         out = params["out"]
+        y = jnp.tanh(mlp.dense_apply(out["l1"], h1, cfg.matmul_dtype))
+        y = jnp.tanh(mlp.dense_apply(out["l2"], y, cfg.matmul_dtype))
         # oversized shapes (e.g. eval batches >= ~400 rows) exceed the
-        # kernel's scoped-VMEM budget — the unfused branch below computes
-        # the identical logits-form likelihood, so fall through silently
-        if fits_vmem(h1.shape[0], h1.shape[1], out["out"]["w"].shape[0],
-                     out["out"]["w"].shape[-1]):
-            y = jnp.tanh(mlp.dense_apply(out["l1"], h1, cfg.matmul_dtype))
-            y = jnp.tanh(mlp.dense_apply(out["l2"], y, cfg.matmul_dtype))
+        # kernel's scoped-VMEM budget — the unfused tail below computes
+        # the identical logits-form likelihood, so fall through silently.
+        # kernel_usable also probe-compiles once per shape/dtype (y is the
+        # actual kernel operand), so an estimate misprediction on a
+        # non-v5e generation falls back instead of crashing the jit.
+        if kernel_usable(y.shape[0], y.shape[1], out["out"]["w"].shape[0],
+                         out["out"]["w"].shape[-1], interpret=not _on_tpu(),
+                         dtype=y.dtype):
             return fused_bernoulli_ll(y, out["out"]["w"], out["out"]["b"], x,
                                       not _on_tpu())
+        # same math as decode_logits, reusing the y already computed
+        logits = mlp.dense_apply(out["out"], y,
+                                 cfg.matmul_dtype).astype(jnp.float32)
+        lp = dist.bernoulli_log_prob_from_logits(x, logits)
+        return jnp.sum(lp, axis=-1)
     logits = decode_logits(params, cfg, h1)
     if cfg.likelihood == "clamp":
         probs = dist.clamp_probs(jax.nn.sigmoid(logits))

@@ -43,35 +43,131 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 TILE_K = 8    # K-slab height (sublane-aligned; Pallas requires multiples of 8)
-_VMEM_BUDGET = 13 * 1024 * 1024  # bytes; the TPU scoped-vmem limit is 16M
+
+#: usable scoped-VMEM budget (bytes) by device-generation substring of
+#: ``jax.Device.device_kind``. 13M was measured on v5e (16M scoped-vmem limit;
+#: batch 300 compiles at ~12.3M est, batch 400 fails at ~16.2M). Every current
+#: TPU generation documents ~16 MB VMEM/core, so the same conservative margin
+#: is the default; a generation measured to differ gets its own row. Shapes the
+#: estimate mispredicts are caught by the probe-compile in `kernel_usable` —
+#: a wrong row here costs a fallback, never a crash.
+VMEM_BUDGETS = {"default": 13 * 1024 * 1024}
+
+
+def _vmem_budget() -> int:
+    """Scoped-VMEM budget for the local device generation.
+
+    Override with ``IWAE_FUSED_VMEM_BUDGET`` (bytes) — also the lever for
+    forcing the fallback path in tests."""
+    import os
+    env = os.environ.get("IWAE_FUSED_VMEM_BUDGET")
+    if env:
+        return int(env)
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # uninitialized backend etc. — be conservative
+        return VMEM_BUDGETS["default"]
+    for sub, budget in VMEM_BUDGETS.items():
+        if sub != "default" and sub in kind:
+            return budget
+    return VMEM_BUDGETS["default"]
 
 
 def fits_vmem(k: int, b: int, hdim: int, n_pixels: int,
-              grad: bool = False) -> bool:
+              grad: bool = False, itemsize: int = 4) -> bool:
     """Whether the kernel's per-program VMEM working set fits at TILE_K.
 
     The K-slab cannot shrink below 8 (TPU sublane rule), so oversized shapes
     cannot be tiled smaller — they must fall back to the unfused XLA
-    composition instead of failing to compile. Two gates use this:
+    composition instead of failing to compile. Two gates use this (both via
+    :func:`kernel_usable`, which adds a probe-compile safety net):
 
-    * models/iwae.log_px_given_h checks the forward estimate (measured on
-      v5e: batch 300 compiles at ~12.3M est, batch 400 fails at ~16.2M —
-      the 13M budget separates them) and skips the kernel entirely when it
-      cannot fit;
+    * models/iwae.log_px_given_h checks the forward estimate and skips the
+      kernel entirely when it cannot fit;
     * _fused_bwd checks the larger `grad=True` estimate (recomputed logits
       + x/g rows + dlogits slabs; batch 200 was observed to exceed scoped
       vmem at 17.7M) and swaps in the XLA backward while keeping the fused
       forward.
+
+    `itemsize` is the *operand* element width in bytes and scales only the
+    streamed input blocks (h/w/bias/x/g); the logits/dlogits tiles and the
+    dh/dW/db accumulators are f32 regardless (the kernel computes with
+    ``preferred_element_type=jnp.float32``), so those terms stay at 4 bytes.
+    At itemsize=4 both formulas reduce exactly to the v5e-calibrated
+    estimate. NOTE: today every caller passes f32 — ``mlp.dense_apply`` pins
+    its output to f32 even under ``compute_dtype=bfloat16`` — so the
+    itemsize<4 path is future-proofing for a bf16-operand kernel variant.
     """
     p_pad = _pixel_pad(n_pixels)
     tk = min(TILE_K, k)
     if grad:
-        est = (3 * tk * b * p_pad + 2 * tk * b * hdim
-               + 2 * hdim * p_pad + b * p_pad + tk * b + p_pad)
+        # f32: logits + dlogits + g_rows tiles, dh out, dW/db accumulators
+        est = 4 * (3 * tk * b * p_pad + tk * b * hdim + hdim * p_pad + p_pad)
+        # operand blocks: h, w, x, g
+        est += itemsize * (tk * b * hdim + hdim * p_pad + b * p_pad + tk * b)
     else:
-        est = (tk * b * p_pad + tk * b * hdim + hdim * p_pad
-               + b * p_pad + tk * b)
-    return 4 * est <= _VMEM_BUDGET
+        # f32: logits tile + out rows; operands: h, w, x
+        est = 4 * (tk * b * p_pad + tk * b)
+        est += itemsize * (tk * b * hdim + hdim * p_pad + b * p_pad)
+    return est <= _vmem_budget()
+
+
+_probe_cache: dict = {}
+
+
+def kernel_usable(k: int, b: int, hdim: int, n_pixels: int, *,
+                  grad: bool = False, interpret: bool = False,
+                  dtype=jnp.float32) -> bool:
+    """The production gate: analytic estimate + one probe compile per shape.
+
+    The estimate is calibrated on v5e; on other generations it may mispredict
+    in either direction. Saying "doesn't fit" when it would only costs the
+    fused kernel's speedup; saying "fits" for a shape that fails to compile
+    used to crash the enclosing jit. So the first time a shape passes the
+    estimate, the kernel is AOT-compiled standalone (abstract args, no device
+    data); a compile failure logs once and permanently falls back to the
+    unfused composition for that shape. Interpret mode (CPU tests) has no
+    scoped-VMEM limit — the estimate alone decides.
+
+    `dtype` is the dtype of the streamed operands (``y``/w/bias/x — the probe
+    compiles exactly that variant, and the cache keys on it).
+    """
+    dtype = jnp.dtype(dtype)
+    if not fits_vmem(k, b, hdim, n_pixels, grad=grad, itemsize=dtype.itemsize):
+        return False
+    if interpret:
+        return True
+    key = (k, b, hdim, n_pixels, grad, dtype.name)
+    hit = _probe_cache.get(key)
+    if hit is None:
+        hit = _probe_compiles(k, b, hdim, n_pixels, grad, dtype)
+        _probe_cache[key] = hit
+    return hit
+
+
+def _probe_compiles(k: int, b: int, hdim: int, n_pixels: int,
+                    grad: bool, dtype) -> bool:
+    import warnings
+    s = jax.ShapeDtypeStruct
+    args = (s((k, b, hdim), dtype), s((hdim, n_pixels), dtype),
+            s((n_pixels,), dtype), s((b, n_pixels), dtype))
+    if grad:
+        fn = functools.partial(_bwd_pallas, interpret=False)
+        # the cotangent arrives in f32 (the kernel's out dtype)
+        args = args + (s((k, b), jnp.float32),)
+    else:
+        fn = functools.partial(_fwd_pallas, interpret=False)
+    try:
+        jax.jit(fn).lower(*args).compile()
+        return True
+    except Exception as e:  # scoped-vmem overflow, Mosaic layout limits, ...
+        warnings.warn(
+            f"fused-likelihood kernel failed to compile for shape "
+            f"k={k} b={b} h={hdim} d={n_pixels} grad={grad} on "
+            f"{jax.devices()[0].device_kind!r}; using the unfused XLA "
+            f"composition for this shape ({type(e).__name__}: {str(e)[:200]})",
+            RuntimeWarning, stacklevel=3)
+        return False
 
 
 def _pixel_pad(n_pixels: int) -> int:
@@ -231,7 +327,8 @@ def _bwd_reference(h1, w, bias, x, g):
 def _fused_bwd(interpret, res, g):
     h1, w, bias, x = res
     k, b, hdim = h1.shape
-    if fits_vmem(k, b, hdim, w.shape[-1], grad=True):
+    if kernel_usable(k, b, hdim, w.shape[-1], grad=True, interpret=interpret,
+                     dtype=h1.dtype):
         dh, dw, db = _bwd_pallas(h1, w, bias, x, g, interpret=interpret)
     else:
         # backward working set over scoped-vmem budget (e.g. batch >= ~150):

@@ -99,6 +99,49 @@ class TestKernelParity:
         assert not fits_vmem(8, 400, 200, 784)
         assert not fits_vmem(8, 200, 200, 784, grad=True)
 
+    def test_vmem_budget_env_override(self, monkeypatch):
+        """IWAE_FUSED_VMEM_BUDGET (bytes) overrides the per-generation budget
+        — the test/ops lever for forcing the unfused fallback."""
+        from iwae_replication_project_tpu.ops import fused_likelihood as fl
+        monkeypatch.setenv("IWAE_FUSED_VMEM_BUDGET", "1")
+        assert not fl.fits_vmem(8, 4, 16, 12)
+        assert not fl.kernel_usable(8, 4, 16, 12, interpret=True)
+        monkeypatch.setenv("IWAE_FUSED_VMEM_BUDGET", str(1 << 30))
+        assert fl.fits_vmem(8, 400, 200, 784)
+
+    def test_bf16_itemsize_scales_operand_terms_only(self):
+        """itemsize scales the streamed operand blocks but NOT the f32
+        logits tile / accumulators (the kernel computes with
+        preferred_element_type=f32): batch 400's ~11.5M f32 logits tile
+        alone keeps it over budget even with bf16 operands, while batch 350
+        (f32 est ~14.3M) is admitted at bf16 (~12.2M)."""
+        from iwae_replication_project_tpu.ops.fused_likelihood import fits_vmem
+        assert not fits_vmem(8, 400, 200, 784, itemsize=2)
+        assert not fits_vmem(8, 350, 200, 784, itemsize=4)
+        assert fits_vmem(8, 350, 200, 784, itemsize=2)
+
+    def test_probe_compile_failure_falls_back(self, monkeypatch):
+        """A shape that passes the estimate but fails to compile (other chip
+        generation, Mosaic limit...) must warn once and permanently use the
+        unfused path — never crash the enclosing jit (VERDICT r4 Weak #3)."""
+        from iwae_replication_project_tpu.ops import fused_likelihood as fl
+
+        calls = []
+
+        def boom(*a, **kw):
+            calls.append(a)
+            raise RuntimeError("scoped vmem exceeded (simulated)")
+
+        monkeypatch.setattr(fl, "_probe_cache", {})
+        monkeypatch.setattr(fl, "_bwd_pallas", boom)
+        monkeypatch.setattr(fl, "_fwd_pallas", boom)
+        with pytest.warns(RuntimeWarning, match="failed to compile"):
+            assert not fl.kernel_usable(8, 4, 16, 12, interpret=False)
+        assert len(calls) == 1
+        # cached: the second query neither warns nor re-probes
+        assert not fl.kernel_usable(8, 4, 16, 12, interpret=False)
+        assert len(calls) == 1
+
     def test_oversized_backward_falls_back_exactly(self):
         """A batch over the backward VMEM budget still differentiates: the
         custom VJP swaps in the XLA backward, whose grads must match the
