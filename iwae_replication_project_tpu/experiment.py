@@ -21,6 +21,7 @@ import jax
 
 from iwae_replication_project_tpu.data import load_dataset, epoch_batches
 from iwae_replication_project_tpu.evaluation import metrics as ev
+from iwae_replication_project_tpu.parallel.multihost import fetch
 from iwae_replication_project_tpu.training import (
     burda_stages,
     create_train_state,
@@ -127,13 +128,25 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
 
     ckpt_dir = os.path.join(cfg.checkpoint_dir, cfg.run_name())
     start_stage = 1
+    start_offset = 0  # passes already done within start_stage (mid-stage resume)
     if cfg.resume:
         restored = restore_latest(ckpt_dir, state,
                                   expect_config_json=cfg.to_json())
         if restored is not None:
-            _, state, start_stage = restored
-            start_stage += 1
-            print(f"resumed from checkpoint; continuing at stage {start_stage}")
+            _, state, ckpt_stage, passes_done = restored
+            stage_lengths = {s: n for s, _, n in
+                             burda_stages(cfg.n_stages, cfg.passes_scale)}
+            if passes_done is not None and \
+                    passes_done < stage_lengths.get(ckpt_stage, 0):
+                start_stage, start_offset = ckpt_stage, passes_done
+                if is_primary:
+                    print(f"resumed from mid-stage checkpoint; continuing at "
+                          f"stage {start_stage}, pass {start_offset + 1}")
+            else:
+                start_stage = ckpt_stage + 1
+                if is_primary:
+                    print(f"resumed from checkpoint; continuing at stage "
+                          f"{start_stage}")
         else:
             # run_name() embeds a hash of the science fields, so checkpoints
             # written under an older naming scheme (or an edited config) are
@@ -174,15 +187,38 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         if is_primary:
             print(f"stage {stage}: lr={lr:.2e}, {passes} passes, "
                   f"objective {active_spec.name} k={active_spec.k}")
-        remaining = passes
-        if passes >= PASS_BLOCK and max_batches_per_pass is None:
+        offset = start_offset if stage == start_stage else 0
+        done = offset          # passes completed within this stage
+        since_save = 0         # passes since the last intra-stage checkpoint
+
+        def maybe_save_mid_stage():
+            # save at dispatch boundaries once >= checkpoint_every_passes
+            # passes have accumulated — but never for the final boundary,
+            # which the end-of-stage save below covers
+            nonlocal since_save
+            if cfg.checkpoint_every_passes \
+                    and since_save >= cfg.checkpoint_every_passes \
+                    and done < passes:
+                save_checkpoint(ckpt_dir, int(fetch(state.step)), state, stage,
+                                config_json=cfg.to_json(),
+                                keep=cfg.checkpoint_keep, passes_done=done)
+                since_save = 0
+
+        remaining = passes - offset
+        if remaining >= PASS_BLOCK and max_batches_per_pass is None:
             block_fn = epoch_fn_for(active_spec, PASS_BLOCK)
-            for _ in range(passes // PASS_BLOCK):
+            for _ in range(remaining // PASS_BLOCK):
                 state, _ = block_fn(state, x_train_dev)
-            remaining = passes % PASS_BLOCK
+                done += PASS_BLOCK
+                since_save += PASS_BLOCK
+                maybe_save_mid_stage()
+            remaining = remaining % PASS_BLOCK
         epoch_fn = epoch_fn_for(active_spec)
         for _ in range(remaining):
             state, _ = epoch_fn(state, x_train_dev)
+            done += 1
+            since_save += 1
+            maybe_save_mid_stage()
 
         if mesh is not None:
             from iwae_replication_project_tpu.parallel.eval import (
@@ -213,7 +249,6 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         if is_primary:
             print({k: round(v, 4) for k, v in res.items()
                    if isinstance(v, float)})
-        from iwae_replication_project_tpu.parallel.multihost import fetch
         step_n = int(fetch(state.step))
         results_history.append((res, {
             "number_of_active_units": res2["number_of_active_units"],

@@ -54,7 +54,11 @@ def _manager(directory: str, keep: int = 3) -> ocp.CheckpointManager:
 
 
 def save_checkpoint(directory: str, step: int, state: TrainState, stage: int,
-                    config_json: str = "", keep: int = 3) -> None:
+                    config_json: str = "", keep: int = 3,
+                    passes_done: Optional[int] = None) -> None:
+    """`passes_done` = passes completed *within* `stage` at save time; None
+    (and every pre-r5 checkpoint, whose meta lacks the field) means the stage
+    finished — resume continues at the next stage."""
     mgr = _manager(directory, keep)
     payload = {
         "params": state.params,
@@ -62,9 +66,12 @@ def save_checkpoint(directory: str, step: int, state: TrainState, stage: int,
         "key": state.key,
         "step": state.step,
     }
+    meta = {"config": config_json, "stage": stage}
+    if passes_done is not None:
+        meta["passes_done"] = int(passes_done)
     mgr.save(step, args=ocp.args.Composite(
         state=ocp.args.StandardSave(payload),
-        meta=ocp.args.JsonSave({"config": config_json, "stage": stage}),
+        meta=ocp.args.JsonSave(meta),
     ))
     mgr.wait_until_finished()
     mgr.close()
@@ -81,8 +88,12 @@ def latest_step(directory: str) -> Optional[int]:
 
 def restore_latest(directory: str, template: TrainState, *,
                    expect_config_json: Optional[str] = None
-                   ) -> Optional[Tuple[int, TrainState, int]]:
-    """Restore ``(step, state, stage)`` from the newest checkpoint, or None.
+                   ) -> Optional[Tuple[int, TrainState, int, Optional[int]]]:
+    """Restore ``(step, state, stage, passes_done)`` from the newest
+    checkpoint, or None. ``passes_done`` is the number of passes completed
+    within ``stage`` when the checkpoint was written — None when the stage
+    had finished (also for pre-r5 checkpoints, which only saved at stage
+    boundaries).
 
     `template` supplies the pytree structure/dtypes (an identically-constructed
     fresh TrainState). When `expect_config_json` is given, the stored config is
@@ -98,6 +109,9 @@ def restore_latest(directory: str, template: TrainState, *,
     # pytree/shape error instead of the intended message
     meta = mgr.restore(step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))["meta"]
     stage = int(meta["stage"])
+    passes_done = meta.get("passes_done")
+    if passes_done is not None:
+        passes_done = int(passes_done)
     if expect_config_json:
         stored_id = _config_identity(meta.get("config", ""))
         expect_id = _config_identity(expect_config_json)
@@ -120,4 +134,4 @@ def restore_latest(directory: str, template: TrainState, *,
     payload = restored["state"]
     state = TrainState(params=payload["params"], opt_state=payload["opt_state"],
                        key=payload["key"], step=payload["step"])
-    return step, state, stage
+    return step, state, stage, passes_done

@@ -116,6 +116,14 @@ class ExperimentConfig:
     checkpoint_dir: str = "checkpoints"
     checkpoint_keep: int = 3
     resume: bool = True
+    # also checkpoint every N passes *inside* a stage (0 = stage boundaries
+    # only). Stage 8 alone is 2187 of the schedule's 3280 passes — without
+    # this a preemption near the end of a real run loses two thirds of the
+    # work. Saves land on dispatch boundaries (single passes, or PASS_BLOCK
+    # multiples during the fused late stages), so the cadence is "at the
+    # first boundary >= N passes since the last save". Resume restarts
+    # mid-stage bit-identically (the whole-epoch scan carries the RNG key).
+    checkpoint_every_passes: int = 0
 
     def model_config(self) -> ModelConfig:
         fused = self.fused_likelihood
@@ -227,6 +235,10 @@ def build_argparser() -> argparse.ArgumentParser:
                     action="store_false", default=None)
     ap.add_argument("--log-dir", dest="log_dir", default=None, type=str)
     ap.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None, type=str)
+    ap.add_argument("--checkpoint-every-passes", dest="checkpoint_every_passes",
+                    default=None, type=int,
+                    help="also checkpoint every N passes inside a stage "
+                         "(0 = stage boundaries only)")
     ap.add_argument("--no-resume", dest="resume", action="store_false", default=None)
     ap.add_argument("--no-figures", dest="save_figures", action="store_false",
                     default=None)

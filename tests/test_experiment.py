@@ -5,6 +5,7 @@ import json
 import os
 import sys
 
+import jax
 import numpy as np
 import pytest
 
@@ -145,6 +146,63 @@ class TestRunExperiment:
         _, hist_mesh_single = run_experiment(cfg4, eval_subset=32)
         for (ra, _), (rb, _) in zip(hist_mesh_block, hist_mesh_single):
             assert abs(ra["NLL"] - rb["NLL"]) < 1e-3, (ra["NLL"], rb["NLL"])
+
+    @pytest.mark.slow
+    def test_mid_stage_kill_resume_bit_identical(self, tmp_path, monkeypatch):
+        """Preemption mid-stage must lose at most checkpoint_every_passes
+        passes: kill the run right after an intra-stage save, resume, and the
+        final state must be BIT-identical to an uninterrupted run (the
+        whole-epoch scan carries the RNG key, so the pass stream is exactly
+        reproducible regardless of where it was cut; VERDICT r4 #2)."""
+        import iwae_replication_project_tpu.experiment as exp
+
+        # uninterrupted reference (3 stages: 1+3+9 passes)
+        cfgA = tiny_config(tmp_path, n_stages=3, resume=False,
+                           save_figures=False,
+                           log_dir=str(tmp_path / "runsA"),
+                           checkpoint_dir=str(tmp_path / "ckptA"))
+        stateA, histA = run_experiment(cfgA, max_batches_per_pass=2,
+                                       eval_subset=32)
+
+        # interrupted run: save every 2 passes, die right after the 5th save
+        # (= stage 3, 4 of 9 passes done — mid-stage)
+        cfgB = tiny_config(tmp_path, n_stages=3, save_figures=False,
+                           checkpoint_every_passes=2,
+                           log_dir=str(tmp_path / "runsB"),
+                           checkpoint_dir=str(tmp_path / "ckptB"))
+        real_save = exp.save_checkpoint
+        calls = {"n": 0}
+
+        def dying_save(*a, **kw):
+            real_save(*a, **kw)
+            calls["n"] += 1
+            if calls["n"] == 5:
+                raise KeyboardInterrupt("simulated preemption")
+
+        monkeypatch.setattr(exp, "save_checkpoint", dying_save)
+        with pytest.raises(KeyboardInterrupt):
+            run_experiment(cfgB, max_batches_per_pass=2, eval_subset=32)
+        monkeypatch.setattr(exp, "save_checkpoint", real_save)
+
+        # resume: must continue at stage 3, pass 5
+        import io
+        from contextlib import redirect_stdout
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            stateB, histB = run_experiment(cfgB, max_batches_per_pass=2,
+                                           eval_subset=32)
+        assert "stage 3, pass 5" in buf.getvalue()
+        assert len(histB) == 1 and histB[0][0]["stage"] == 3
+
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), stateA.params, stateB.params)
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+            stateA.opt_state.inner_state[0].mu,
+            stateB.opt_state.inner_state[0].mu)
+        np.testing.assert_array_equal(np.asarray(stateA.key),
+                                      np.asarray(stateB.key))
+        assert histA[-1][0]["NLL"] == histB[0][0]["NLL"]
 
     def test_passes_scale_shrinks_schedule(self, tmp_path):
         """passes_scale proportionally shrinks the Burda schedule (min 1 pass

@@ -111,8 +111,9 @@ class TestCheckpoint:
         template = create_train_state(jax.random.PRNGKey(99), CFG)
         restored = restore_latest(d, template)
         assert restored is not None
-        rstep, rstate, rstage = restored
+        rstep, rstate, rstage, rpasses = restored
         assert rstep == 1 and rstage == 3
+        assert rpasses is None  # no passes_done given -> stage complete
         jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a),
                                                                 np.asarray(b)),
                      state.params, rstate.params)
@@ -120,6 +121,16 @@ class TestCheckpoint:
                                                                 np.asarray(b)),
                      state.opt_state.inner_state[0].mu,
                      rstate.opt_state.inner_state[0].mu)
+
+    def test_passes_done_roundtrip(self, rng, tmp_path):
+        """Mid-stage checkpoints carry (stage, passes_done); stage-boundary
+        checkpoints (and every pre-r5 payload) restore passes_done=None."""
+        d = os.path.join(str(tmp_path), "ckpt")
+        state = create_train_state(rng, CFG)
+        save_checkpoint(d, 1, state, stage=5, passes_done=81)
+        template = create_train_state(jax.random.PRNGKey(99), CFG)
+        _, _, rstage, rpasses = restore_latest(d, template)
+        assert (rstage, rpasses) == (5, 81)
 
     def test_restore_missing_returns_none(self, rng, tmp_path):
         template = create_train_state(rng, CFG)
