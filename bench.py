@@ -89,6 +89,84 @@ def peak_flops():
     return None
 
 
+def step_flops_for(hidden: int, batch: int, k: int) -> float:
+    """`train_step_flops` generalized to a width-scaled architecture: every
+    dim of the 2L flagship scales with hidden/200 except the 784 pixels
+    (hidden -> (h, h/2) enc hiddens, (h/2, h/4) latents, mirrored decoder).
+    At hidden=200 this reproduces `train_step_flops` exactly."""
+    h, h2, l1, l2 = hidden, hidden // 2, hidden // 2, hidden // 4
+    per_row_noK = _block_flops(784, h, l1)
+    per_row_K = (_block_flops(l1, h2, l2) + _block_flops(l2, h2, l1)
+                 + (l1 * h + h * h + h * 784))
+    fwd = 2.0 * (batch * per_row_noK + batch * k * per_row_K)
+    return 3.0 * fwd
+
+
+def scaled_config(hidden: int, on_tpu: bool, compute_dtype=None):
+    from iwae_replication_project_tpu.models import ModelConfig
+    h, h2, l1, l2 = hidden, hidden // 2, hidden // 2, hidden // 4
+    return ModelConfig(n_hidden_enc=(h, h2), n_latent_enc=(l1, l2),
+                       n_hidden_dec=(h2, h), n_latent_dec=(l1, 784),
+                       likelihood="logits", fused_likelihood=on_tpu,
+                       compute_dtype=compute_dtype)
+
+
+def bench_scaling():
+    """Width-scaling MFU sweep (VERDICT r4 #1): the same whole-epoch scanned
+    IWAE step at hidden widths 200..2048 (all dims scaled except the 784
+    pixels), k=50, batch {100, 256}, f32 and bf16-matmul variants. Prints one
+    JSON line with a row per shape: steps/s, analytic TFLOP/s, MFU.
+
+    Purpose: the flagship widths (50-200) leave the 128x128 MXU tiles
+    quarter-filled — this sweep measures whether MFU climbs when the tiles
+    fill (architecture was the bottleneck) or stalls (framework bottleneck
+    hidden behind the parity shapes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from iwae_replication_project_tpu.objectives import ObjectiveSpec
+    from iwae_replication_project_tpu.training import create_train_state
+    from iwae_replication_project_tpu.training.epoch import make_epoch_fn
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    peak = peak_flops()
+    n_train = 25600  # divisible by both batch sizes; 256/100 steps per epoch
+    x = jnp.asarray(make_data(n_train))
+    spec = ObjectiveSpec("IWAE", k=K)
+    rows = []
+    shapes = [(h, b, dt) for h in (200, 512, 1024, 2048)
+              for b, dt in ((100, None), (256, None), (256, "bfloat16"))]
+    for hidden, batch, dtype in shapes:
+        cfg = scaled_config(hidden, on_tpu, compute_dtype=dtype)
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        epoch = make_epoch_fn(spec, cfg, n_train, batch, donate=False)
+        state, losses = epoch(state, x)     # compile + warmup
+        np.asarray(losses)
+        steps = n_train // batch
+        rates = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            state, losses = epoch(state, x)
+            np.asarray(losses)              # honest completion sync
+            rates.append(steps / (time.perf_counter() - t0))
+        sps = float(np.mean(rates))
+        flops = step_flops_for(hidden, batch, K)
+        rows.append({
+            "hidden": hidden, "batch": batch,
+            "dtype": dtype or "float32",
+            "steps_per_sec": round(sps, 2),
+            "tflops_per_sec": round(sps * flops / 1e12, 2),
+            "mfu": round(sps * flops / peak, 4) if peak else None,
+        })
+        print(json.dumps(rows[-1]), flush=True)
+    print(json.dumps({
+        "metric": "IWAE-k50-2L width-scaling sweep (whole-epoch scan)",
+        "unit": "per-shape steps/sec + analytic TFLOP/s + MFU",
+        "peak_flops": peak,
+        "rows": rows,
+    }))
+
+
 def _train_rates(cfg, reps=REPS):
     import jax
     import jax.numpy as jnp
@@ -180,6 +258,10 @@ def bench_baseline() -> tuple:
 
 
 def main():
+    import sys
+    if "--scaling" in sys.argv:
+        bench_scaling()
+        return
     rates, rates_bf16, eval_rates = bench_jax()
     base_sps, base_n = bench_baseline()
     mean_sps = float(np.mean(rates))

@@ -10,11 +10,17 @@ produce committed numbers in this zero-egress environment:
   evidence at the exact Table-1 headline config; its NLLs are NOT comparable
   to 84.77 (real binarized MNIST is unobtainable offline; see RESULTS.md).
 
-Artifacts land in results/runs/<run_name>/ (metrics.jsonl, figures/) — a
-directory that IS committed, unlike the scratch `runs/` dir. Total wall time
-on one TPU v5e chip is a few minutes; rerun with:
+Artifacts land in results/runs/<run_name>/ — a directory that IS committed,
+unlike the scratch `runs/` dir. Committed per run: metrics.jsonl (the
+numbers) and results.pkl for the flagship. Per-stage PNGs and tfevents files
+are REGENERABLE binaries and are NOT committed (advisor r3: they accreted
+~360 files / 12 MB by round 4; pruned in round 5 keeping one representative
+figure set, the flagship IWAE-2L-k50-digits run). To regenerate any run's
+figures/tfevents, rerun this script — runs are deterministic per seed:
 
     python scripts/run_replication.py [--quick]
+
+Total wall time on one TPU v5e chip is a few minutes.
 """
 
 from __future__ import annotations
