@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import time
 from typing import Optional
 
 import jax
@@ -204,6 +205,7 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
                                 keep=cfg.checkpoint_keep, passes_done=done)
                 since_save = 0
 
+        t_train = time.perf_counter()
         remaining = passes - offset
         if remaining >= PASS_BLOCK and max_batches_per_pass is None:
             block_fn = epoch_fn_for(active_spec, PASS_BLOCK)
@@ -219,7 +221,13 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
             done += 1
             since_save += 1
             maybe_save_mid_stage()
+        # fetch forces completion of the async dispatches (np.asarray under
+        # the hood — block_until_ready only reports enqueue on remote
+        # transports), so the stage timings are honest train/eval splits
+        step_n = int(fetch(state.step))
+        train_s = time.perf_counter() - t_train
 
+        t_eval = time.perf_counter()
         if mesh is not None:
             from iwae_replication_project_tpu.parallel.eval import (
                 parallel_training_statistics)
@@ -244,12 +252,15 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         # initialized under (raw-means = the reference's fixed-bin policy)
         res["synthetic_data"] = bool(ds.synthetic)
         res["raw_means_bias"] = ds.bias_source == "raw"
+        # wall-clock per stage (train = the passes incl. checkpoint saves,
+        # eval = the full statistics suite), for capacity planning
+        res["stage_train_seconds"] = round(train_s, 3)
+        res["stage_eval_seconds"] = round(time.perf_counter() - t_eval, 3)
         # `res` already carries "nll_chunk" — the EFFECTIVE chunk the eval
         # driver used (clamped per device under sp) — as the eval-RNG version
         if is_primary:
             print({k: round(v, 4) for k, v in res.items()
                    if isinstance(v, float)})
-        step_n = int(fetch(state.step))
         results_history.append((res, {
             "number_of_active_units": res2["number_of_active_units"],
             "number_of_PCA_active_units": res2["number_of_PCA_active_units"]}))

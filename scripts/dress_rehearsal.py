@@ -1,0 +1,152 @@
+"""MNIST-scale dress rehearsal (VERDICT r4 #7): the production run at
+production scale, wall-clock measured.
+
+Every staged run through round 4 used <= 1.5k images or CI-sized fixtures;
+this script measures the one thing those cannot: the full
+``northstar-iwae-2l-k50`` preset — 8 Burda stages, 3280 passes over a
+50,000 x 784 train set, full 10k-image eval suite (k=5000 streaming NLL,
+activity, pruned NLL) per stage — end to end on one chip, including the
+real-file-sized data loading.
+
+The data is synthetic (this image has no network egress and no real MNIST
+files — RESULTS.md §1), but written AT THE REAL SIZES in the reference's
+on-disk formats so the whole pipeline is exercised exactly as a real
+replication would: `binarized_mnist_{train,test}.amat` (Larochelle text
+format, ~78 MB / ~16 MB) plus raw `train-images-idx3-ubyte.gz` so the
+decoder bias follows the reference's raw-means policy
+(flexible_IWAE.py:150-155). NLLs are NOT comparable to the 84.77 north star;
+the wall-clock and per-stage timing table are the deliverables.
+
+Run:  python scripts/dress_rehearsal.py [--checkpoint-every-passes N]
+Output: per-stage table + one JSON summary line (written to
+results/dress_rehearsal.json ONLY when this process measured all stages
+fresh — a resumed/partial run prints its table but leaves the committed
+measurement alone); fixture files land in data/rehearsal/ (gitignored,
+~95 MB, reused across runs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA_DIR = os.path.join(REPO, "data", "rehearsal")
+OUT_JSON = os.path.join(REPO, "results", "dress_rehearsal.json")
+
+N_TRAIN, N_TEST = 50_000, 10_000
+
+
+def make_fixture_files(data_dir: str = DATA_DIR) -> float:
+    """Write the real-size reference-format files (idempotent); returns the
+    generation seconds (0.0 when already present)."""
+    from iwae_replication_project_tpu.data.loaders import _synthetic
+    from tests.fixture_io import write_idx_gz
+
+    train_p = os.path.join(data_dir, "binarized_mnist_train.amat")
+    test_p = os.path.join(data_dir, "binarized_mnist_test.amat")
+    raw_tr_p = os.path.join(data_dir, "train-images-idx3-ubyte.gz")
+    raw_te_p = os.path.join(data_dir, "t10k-images-idx3-ubyte.gz")
+    paths = (train_p, test_p, raw_tr_p, raw_te_p)
+    if all(os.path.exists(p) for p in paths):
+        return 0.0
+    t0 = time.perf_counter()
+    os.makedirs(data_dir, exist_ok=True)
+    x_train, x_test = _synthetic("binarized_mnist", N_TRAIN, N_TEST, seed=0)
+    # Larochelle .amat: one "%d %d ... %d" line per image
+    np.savetxt(train_p, x_train, fmt="%d")
+    np.savetxt(test_p, x_test, fmt="%d")
+    # raw grayscale (the probabilities scaled to [0,255]) for the raw-means
+    # bias policy — the loader requires the train/t10k idx PAIR
+    gray_tr, gray_te = _synthetic("binarized_mnist", N_TRAIN, N_TEST, seed=0,
+                                  binary=False)
+    write_idx_gz(raw_tr_p, (gray_tr * 255).astype(np.uint8).reshape(-1, 28, 28))
+    write_idx_gz(raw_te_p, (gray_te * 255).astype(np.uint8).reshape(-1, 28, 28))
+    return time.perf_counter() - t0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--checkpoint-every-passes", type=int, default=200)
+    ap.add_argument("--data-dir", default=DATA_DIR)
+    ap.add_argument("--fresh", action="store_true",
+                    help="ignore existing checkpoints (default resumes)")
+    args = ap.parse_args(argv)
+
+    gen_s = make_fixture_files(args.data_dir)
+    print(f"fixture files: {args.data_dir} (generation {gen_s:.1f}s)")
+
+    from iwae_replication_project_tpu import zoo
+    from iwae_replication_project_tpu.experiment import run_experiment
+
+    cfg = zoo.get("northstar-iwae-2l-k50")
+    cfg.data_dir = args.data_dir
+    cfg.allow_synthetic = False  # the files MUST be found — that is the test
+    cfg.log_dir = os.path.join(REPO, "runs", "dress_rehearsal")
+    cfg.checkpoint_dir = os.path.join(REPO, "checkpoints", "dress_rehearsal")
+    cfg.checkpoint_every_passes = args.checkpoint_every_passes
+    cfg.save_figures = False
+    cfg.resume = not args.fresh
+
+    # a pre-existing checkpoint means this process will resume (and its first
+    # stage's timings would cover only the remaining passes): still run, but
+    # mark the measurement partial and keep the committed JSON intact
+    from iwae_replication_project_tpu.utils.checkpoint import latest_step
+    resumed = cfg.resume and latest_step(
+        os.path.join(cfg.checkpoint_dir, cfg.run_name())) is not None
+
+    t0 = time.perf_counter()
+    state, history = run_experiment(cfg)
+    total_s = time.perf_counter() - t0
+
+    rows = []
+    print(f"\n{'stage':>5} {'passes':>6} {'train s':>9} {'eval s':>8} "
+          f"{'steps/s':>9} {'NLL':>9}")
+    from iwae_replication_project_tpu.training import burda_stages
+    lengths = {s: n for s, _, n in burda_stages(cfg.n_stages, cfg.passes_scale)}
+    for res, _ in history:
+        st = int(res["stage"])
+        passes = lengths[st]
+        steps = passes * (N_TRAIN // cfg.batch_size)
+        tr = res.get("stage_train_seconds", float("nan"))
+        ev = res.get("stage_eval_seconds", float("nan"))
+        rows.append({"stage": st, "passes": passes,
+                     "train_seconds": tr, "eval_seconds": ev,
+                     "steps_per_sec": round(steps / tr, 1) if tr else None,
+                     "NLL": round(res["NLL"], 3)})
+        print(f"{st:>5} {passes:>6} {tr:>9.1f} {ev:>8.1f} "
+              f"{steps / tr:>9.1f} {res['NLL']:>9.3f}")
+
+    summary = {
+        "metric": "northstar-iwae-2l-k50 dress rehearsal "
+                  "(synthetic data at real MNIST file sizes)",
+        "n_train": N_TRAIN, "n_test": N_TEST,
+        "total_seconds": round(total_s, 1),
+        "fixture_generation_seconds": round(gen_s, 1),
+        "checkpoint_every_passes": args.checkpoint_every_passes,
+        "stages": rows,
+    }
+    print(json.dumps(summary))
+    complete = not resumed and len(rows) == cfg.n_stages
+    if complete:
+        try:
+            with open(OUT_JSON, "w") as f:
+                json.dump(summary, f, indent=1)
+            print(f"wrote {OUT_JSON}")
+        except OSError:
+            pass
+    else:
+        print(f"partial/resumed run ({len(rows)}/{cfg.n_stages} stages "
+              f"measured{', resumed' if resumed else ''}): NOT overwriting "
+              f"{OUT_JSON}; rerun with --fresh for a full measurement")
+
+
+if __name__ == "__main__":
+    main()

@@ -112,7 +112,8 @@ def replication_suite(n_stages: int = 8):
     return runs
 
 
-def seed_study(seeds=(1, 2), n_stages: int = 8, passes_scale: float = 1.0):
+def seed_study(seeds=(1, 2), n_stages: int = 8, passes_scale: float = 1.0,
+               compute_dtype=None):
     """Replicate the headline ordering comparison (VAE k=1 vs IWAE k=50, both
     depths) across extra seeds, for the error bars in RESULTS.md §2 (seed 0
     is covered by the main suite at passes_scale=1.0).
@@ -120,9 +121,19 @@ def seed_study(seeds=(1, 2), n_stages: int = 8, passes_scale: float = 1.0):
     With ``passes_scale<1`` (the --scaled mode) the Burda schedule shrinks
     proportionally to the 1.5k-image dataset, which removes the overfitting
     that forced best-stage selection in round 3 — the principled protocol
-    whose final-stage and best-stage NLLs coincide (RESULTS.md §2)."""
+    whose final-stage and best-stage NLLs coincide (RESULTS.md §2).
+
+    With ``compute_dtype="bfloat16"`` (the --bf16-study mode, VERDICT r4 #4)
+    the exact same protocol runs with bf16 matmul operands, writing to
+    separate scratch run/checkpoint dirs — compute_dtype is an execution
+    knob, not a science field, so the run names would otherwise collide with
+    the committed f32 runs and resume would skip the training."""
     runs = []
     tag = "" if passes_scale == 1.0 else f"-ps{passes_scale}"
+    log_dir, ckpt_dir = RESULTS_DIR, "checkpoints"
+    if compute_dtype:
+        tag += f"-{compute_dtype}"
+        log_dir, ckpt_dir = "runs/dtype_study", "checkpoints/dtype_study"
     for seed in seeds:
         for arch_name, arch in (("1L", ARCH_1L), ("2L", ARCH_2L)):
             for loss, k in (("VAE", 1), ("IWAE", 50)):
@@ -132,8 +143,9 @@ def seed_study(seeds=(1, 2), n_stages: int = 8, passes_scale: float = 1.0):
                                  loss_function=loss, k=k, seed=seed,
                                  n_stages=n_stages, eval_batch_size=99,
                                  passes_scale=passes_scale,
-                                 save_figures=False, log_dir=RESULTS_DIR,
-                                 checkpoint_dir="checkpoints", **arch)))
+                                 compute_dtype=compute_dtype,
+                                 save_figures=False, log_dir=log_dir,
+                                 checkpoint_dir=ckpt_dir, **arch)))
     return runs
 
 
@@ -191,6 +203,11 @@ def main(argv=None):
                     help="with --seed-study: use the principled scaled "
                          "schedule (passes_scale=0.2, seeds incl. 0; summary "
                          "lands in results/summary_seeds_scaled.json)")
+    ap.add_argument("--bf16-study", action="store_true",
+                    help="the scaled seed study under compute_dtype=bfloat16 "
+                         "(VERDICT r4 #4: convergence evidence for the bf16 "
+                         "default decision; summary lands in "
+                         "results/summary_seeds_scaled_bf16.json)")
     ap.add_argument("--torch-check", action="store_true",
                     help="run the torch-oracle cross-backend check on digits")
     ap.add_argument("--check-loss", default=None,
@@ -215,7 +232,10 @@ def main(argv=None):
         return
 
     n_stages = 3 if ns.quick else 8
-    if ns.seed_study and ns.scaled:
+    if ns.bf16_study:
+        suite = seed_study(seeds=(0, 1, 2), n_stages=n_stages,
+                           passes_scale=0.2, compute_dtype="bfloat16")
+    elif ns.seed_study and ns.scaled:
         suite = seed_study(seeds=(0, 1, 2), n_stages=n_stages,
                            passes_scale=0.2)
     elif ns.seed_study:
@@ -259,6 +279,7 @@ def main(argv=None):
             "seed": cfg.seed,
             "layers": len(cfg.n_hidden_encoder), "stages": n_stages,
             "passes_scale": cfg.passes_scale,
+            "compute_dtype": cfg.compute_dtype or "float32",
             "synthetic_data": res["synthetic_data"],
             "NLL": round(res["NLL"], 3),
             "best_NLL": round(nlls[best], 3),
@@ -276,6 +297,8 @@ def main(argv=None):
     if ns.quick:
         # smoke runs must never replace committed 8-stage rows in place
         out = os.path.join("results", "summary_quick.json")
+    elif ns.bf16_study:
+        out = os.path.join("results", "summary_seeds_scaled_bf16.json")
     elif ns.seed_study:
         out = os.path.join("results", "summary_seeds_scaled.json"
                            if ns.scaled else "summary_seeds.json")
