@@ -168,6 +168,12 @@ def bench_scaling():
 
 
 def _train_rates(cfg, reps=REPS):
+    """Steps/sec of the production training path: the whole-epoch lax.scan
+    with EPOCHS epochs fused into one dispatch (`epochs_per_call`), the same
+    multi-pass batching the experiment driver uses for the long Burda stages
+    (experiment.py PASS_BLOCK=27; 5 here is conservative). Through round 4
+    the bench dispatched per-epoch, paying 4 extra ~10-15 ms tunnel
+    round-trips per rep that the production driver does not pay."""
     import jax
     import jax.numpy as jnp
 
@@ -177,7 +183,8 @@ def _train_rates(cfg, reps=REPS):
 
     spec = ObjectiveSpec("IWAE", k=K)
     state = create_train_state(jax.random.PRNGKey(0), cfg)
-    epoch = make_epoch_fn(spec, cfg, N_TRAIN, BATCH, donate=False)
+    epoch = make_epoch_fn(spec, cfg, N_TRAIN, BATCH, donate=False,
+                          epochs_per_call=EPOCHS)
     x = jnp.asarray(make_data(N_TRAIN))
 
     state, losses = epoch(state, x)   # compile + warmup
@@ -186,8 +193,7 @@ def _train_rates(cfg, reps=REPS):
     rates = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        for _ in range(EPOCHS):
-            state, losses = epoch(state, x)
+        state, losses = epoch(state, x)
         np.asarray(losses)            # honest completion sync
         rates.append(steps / (time.perf_counter() - t0))
     return rates, state
@@ -200,13 +206,15 @@ def bench_jax():
     from iwae_replication_project_tpu.models import ModelConfig
 
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    cfg = ModelConfig.two_layer(likelihood="logits", fused_likelihood=on_tpu)
+    # headline = the production path: compute_dtype defaults to bfloat16
+    # since round 5 (utils/config.py, RESULTS.md §2b)
+    cfg = ModelConfig.two_layer(likelihood="logits", fused_likelihood=on_tpu,
+                                compute_dtype="bfloat16")
     rates, state = _train_rates(cfg)
-    # secondary datapoint: bfloat16 matmul operands (f32 accumulation/params)
-    cfg_bf16 = ModelConfig.two_layer(likelihood="logits",
-                                     fused_likelihood=on_tpu,
-                                     compute_dtype="bfloat16")
-    rates_bf16, _ = _train_rates(cfg_bf16, reps=1)
+    # secondary datapoint: full-f32 matmuls (the pre-r5 default)
+    cfg_f32 = ModelConfig.two_layer(likelihood="logits",
+                                    fused_likelihood=on_tpu)
+    rates_f32, _ = _train_rates(cfg_f32, reps=1)
 
     # eval path: the full per-batch scalar suite (VAE/IWAE bounds at k=50,
     # streaming k=5000 NLL, recon BCE) over EVAL_N images as ONE fused
@@ -224,7 +232,7 @@ def bench_jax():
         np.asarray(dataset_scalars(state.params, cfg, key, xe, K,
                                    EVAL_K, EVAL_CHUNK))
         eval_rates.append(EVAL_N / (time.perf_counter() - t0))
-    return rates, rates_bf16, eval_rates
+    return rates, rates_f32, eval_rates
 
 
 def bench_baseline() -> tuple:
@@ -262,14 +270,14 @@ def main():
     if "--scaling" in sys.argv:
         bench_scaling()
         return
-    rates, rates_bf16, eval_rates = bench_jax()
+    rates, rates_f32, eval_rates = bench_jax()
     base_sps, base_n = bench_baseline()
     mean_sps = float(np.mean(rates))
-    bf16_sps = float(np.mean(rates_bf16))
+    f32_sps = float(np.mean(rates_f32))
     peak = peak_flops()
     step_flops = train_step_flops(BATCH, K)
     mfu = round(mean_sps * step_flops / peak, 6) if peak else None
-    mfu_bf16 = round(bf16_sps * step_flops / peak, 6) if peak else None
+    mfu_f32 = round(f32_sps * step_flops / peak, 6) if peak else None
     print(json.dumps({
         "metric": "IWAE-k50-2L train throughput (batch 100, whole-epoch scan)",
         "value": round(mean_sps, 2),
@@ -277,7 +285,9 @@ def main():
         "vs_baseline": round(mean_sps / base_sps, 2),
         "spread": {"min": round(min(rates), 2), "max": round(max(rates), 2),
                    "n_reps": len(rates)},
-        "steps_per_sec_bf16": round(bf16_sps, 2),
+        "compute_dtype": "bfloat16",  # headline = production default (r5+);
+        # rounds <=4 benched f32 as the headline
+        "steps_per_sec_f32": round(f32_sps, 2),
         "eval_images_per_sec": round(float(np.mean(eval_rates)), 2),
         "eval_spread": {"min": round(min(eval_rates), 2),
                         "max": round(max(eval_rates), 2),
@@ -285,11 +295,12 @@ def main():
         "eval_config": {"k": EVAL_K, "chunk": EVAL_CHUNK, "batch": EVAL_BATCH,
                         "n_images": EVAL_N,
                         "suite": "full per-batch scalar suite (fused)"},
+        "epochs_per_dispatch": EPOCHS,  # production-cadence batching (r5+;
+        # rounds <=4 dispatched per-epoch)
         "mfu": mfu,
-        "mfu_bf16": mfu_bf16,
-        # both mfu figures share the bf16 peak denominator: the f32 entry is
-        # utilization *of the bf16 peak* (v5e has no published separate f32
-        # matmul peak to divide by), so it understates f32-relative efficiency
+        "mfu_f32": mfu_f32,
+        # both mfu figures share the bf16 peak denominator (v5e has no
+        # published separate f32 matmul peak to divide by)
         "mfu_denominator": "bf16 peak (197e12) for both dtypes",
         "baseline_steps_per_sec": round(base_sps, 3),
         "baseline_steps": base_n,

@@ -252,6 +252,7 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         # initialized under (raw-means = the reference's fixed-bin policy)
         res["synthetic_data"] = bool(ds.synthetic)
         res["raw_means_bias"] = ds.bias_source == "raw"
+        res["bfloat16"] = cfg.compute_dtype == "bfloat16"
         # wall-clock per stage (train = the passes incl. checkpoint saves,
         # eval = the full statistics suite), for capacity planning
         res["stage_train_seconds"] = round(train_s, 3)
@@ -335,6 +336,8 @@ def _run_experiment_eager(cfg: ExperimentConfig,
         res["stage"] = stage
         res["synthetic_data"] = bool(ds.synthetic)
         res["raw_means_bias"] = ds.bias_source == "raw"
+        # the eager oracles accept-and-ignore compute_dtype (f32 math)
+        res["bfloat16"] = False
         print({k: round(v, 4) for k, v in res.items() if isinstance(v, float)})
         logger.log(res, step=step_count)
         results_history.append((res, {

@@ -121,6 +121,24 @@ def restore_latest(directory: str, template: TrainState, *,
                 f"checkpoint at {directory!r} was written by a different "
                 f"experiment config; refusing to resume.\n"
                 f"stored:  {stored_id}\ncurrent: {expect_id}")
+        # compute_dtype is deliberately NOT a science field (params are f32
+        # under either setting, so cross-dtype resume is legal), but it
+        # changes the numerics of the remaining stages — flag the drift so a
+        # mixed-precision trajectory is never silent (e.g. a pre-r5 f32
+        # checkpoint resumed under the round-5 bfloat16 default)
+        import json
+        try:
+            stored_dt = json.loads(meta.get("config", "") or "{}")
+            cur_dt = json.loads(expect_config_json)
+            if isinstance(stored_dt, dict) and isinstance(cur_dt, dict) \
+                    and stored_dt.get("compute_dtype") != cur_dt.get("compute_dtype"):
+                print(f"note: checkpoint was trained with compute_dtype="
+                      f"{stored_dt.get('compute_dtype')!r}; resuming under "
+                      f"compute_dtype={cur_dt.get('compute_dtype')!r} — the "
+                      f"remaining stages use the new dtype (each metrics row "
+                      f"stamps its own 'bfloat16' flag)")
+        except json.JSONDecodeError:
+            pass
     tmpl = {
         "params": template.params,
         "opt_state": template.opt_state,

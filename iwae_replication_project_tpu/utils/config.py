@@ -95,7 +95,27 @@ class ExperimentConfig:
     coordinator: Optional[str] = None
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
-    compute_dtype: Optional[str] = None  # None | "bfloat16"
+    # None (f32 matmuls) | "bfloat16" (bf16 matmul operands, f32 accumulation
+    # and parameters). Default bfloat16 since round 5: the scaled-schedule
+    # digits seed study (results/summary_seeds_scaled_bf16.json, RESULTS.md
+    # §2b) shows final NLLs within -0.36..+0.04 nats of f32 — inside every
+    # config's f32 seed spread (0.4-1.8 nats) — and throughput is
+    # neutral-to-positive (increasingly favorable at MXU-filling widths).
+    # compute_dtype is an execution knob, not a science field: stored config
+    # JSONs pin their own value, so pre-r5 checkpoints/configs reproduce
+    # their f32 numbers exactly; every metrics row stamps `bfloat16`.
+    compute_dtype: Optional[str] = "bfloat16"
+
+    def __post_init__(self):
+        # now that bf16 must be actively turned OFF, the opt-out must not
+        # depend on typos silently meaning f32: only these values are legal,
+        # and "float32" normalizes to None (the ModelConfig f32 encoding)
+        if self.compute_dtype == "float32":
+            self.compute_dtype = None
+        if self.compute_dtype not in (None, "bfloat16"):
+            raise ValueError(
+                f"compute_dtype must be None, 'float32' or 'bfloat16', got "
+                f"{self.compute_dtype!r}")
     # "logits" is the exact Bernoulli log-likelihood x*l - softplus(l) — the
     # fast path bench.py measures, and the default since round 3 (NLL-
     # neutrality vs "clamp" on a trained model is asserted by
@@ -272,4 +292,7 @@ def config_from_args(argv=None) -> ExperimentConfig:
         v = getattr(ns, field.name, None)
         if v is not None:
             setattr(cfg, field.name, v)
+    # CLI overrides bypass construction — re-run the field validation
+    # (normalizes --compute-dtype float32 to None, rejects typos)
+    cfg.__post_init__()
     return cfg

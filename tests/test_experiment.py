@@ -77,6 +77,18 @@ class TestConfig:
         assert config_from_args(["--multihost"]).multihost is True
         assert config_from_args([]).multihost is False
 
+    def test_compute_dtype_validated(self):
+        """bf16 is the default since r5, so opting OUT must be explicit and
+        typo-proof: 'float32' normalizes to None, anything else raises."""
+        assert ExperimentConfig().compute_dtype == "bfloat16"
+        assert ExperimentConfig(compute_dtype="float32").compute_dtype is None
+        assert config_from_args(
+            ["--compute-dtype", "float32"]).compute_dtype is None
+        with pytest.raises(ValueError, match="compute_dtype"):
+            ExperimentConfig(compute_dtype="bf16")
+        with pytest.raises(ValueError, match="compute_dtype"):
+            ExperimentConfig(compute_dtype="float16")
+
 
 class TestRunExperiment:
     @pytest.mark.slow

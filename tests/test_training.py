@@ -153,6 +153,26 @@ class TestCheckpoint:
         assert restore_latest(d, state,
                               expect_config_json=moved.to_json()) is not None
 
+    def test_resume_warns_on_compute_dtype_drift(self, rng, tmp_path, capsys):
+        """compute_dtype is an execution knob (not a science field), so
+        cross-dtype resume is legal — but it must be flagged, or a pre-r5
+        f32 checkpoint silently continues as a mixed-precision trajectory
+        under the r5 bfloat16 default."""
+        from iwae_replication_project_tpu.utils.config import ExperimentConfig
+        d = os.path.join(str(tmp_path), "ckpt")
+        state = create_train_state(rng, CFG)
+        f32_cfg = ExperimentConfig(compute_dtype="float32")
+        save_checkpoint(d, 1, state, stage=2, config_json=f32_cfg.to_json())
+        bf16_cfg = ExperimentConfig(compute_dtype="bfloat16")
+        assert restore_latest(d, state,
+                              expect_config_json=bf16_cfg.to_json()) is not None
+        out = capsys.readouterr().out
+        assert "compute_dtype" in out and "resuming under" in out
+        # same dtype -> no note
+        assert restore_latest(d, state,
+                              expect_config_json=f32_cfg.to_json()) is not None
+        assert "resuming under" not in capsys.readouterr().out
+
     def test_retention(self, rng, tmp_path):
         d = os.path.join(str(tmp_path), "ckpt")
         state = create_train_state(rng, CFG)
