@@ -111,7 +111,9 @@ def main(argv=None):
     from iwae_replication_project_tpu.training.epoch import make_epoch_fn
 
     on_tpu = any(d.platform == "tpu" for d in jax.devices())
-    cfg = ModelConfig.two_layer(likelihood="logits", fused_likelihood=on_tpu)
+    # bfloat16 = the production default since round 5 (utils/config.py)
+    cfg = ModelConfig.two_layer(likelihood="logits", fused_likelihood=on_tpu,
+                                compute_dtype="bfloat16")
     state = create_train_state(jax.random.PRNGKey(0), cfg)
     spec = ObjectiveSpec("IWAE", k=K)
     epoch = make_epoch_fn(spec, cfg, N_TRAIN, BATCH, donate=False)
