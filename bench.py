@@ -41,7 +41,7 @@ K = 50
 EPOCHS = 5        # measured epochs (2500 steps) after 1 warmup/compile epoch
 REPS = 3
 BASELINE_ITERS = 50
-EVAL_BATCH = 200  # the round-4 production default (+22% over 100; utils/config.py)
+EVAL_BATCH = 500  # the round-5 production default (+9% over 200; utils/config.py)
 EVAL_K = 5000
 EVAL_CHUNK = 250  # the round-4 production default (utils/config.py)
 EVAL_REPS = 3
@@ -294,7 +294,10 @@ def main():
                         "n_reps": len(eval_rates)},
         "eval_config": {"k": EVAL_K, "chunk": EVAL_CHUNK, "batch": EVAL_BATCH,
                         "n_images": EVAL_N,
-                        "suite": "full per-batch scalar suite (fused)"},
+                        # batch 500 is past the Pallas forward VMEM gate, so
+                        # the per-batch likelihood runs the unfused XLA path
+                        # (measured faster at this batch — RESULTS.md §4)
+                        "suite": "full per-batch scalar suite"},
         "epochs_per_dispatch": EPOCHS,  # production-cadence batching (r5+;
         # rounds <=4 dispatched per-epoch)
         "mfu": mfu,

@@ -258,8 +258,8 @@ class JaxFlexibleModel(FlexibleModel):
     def get_training_statistics(self, x, k: int, batch_size: int = 100, **kw
                                 ) -> Tuple[dict, dict]:
         # batch_size default stays 100 on the facade (stable RNG stream for
-        # parity work); the production ExperimentConfig default is 200 since
-        # round 4 (+22% fused eval, utils/config.py). The effective batch is
+        # parity work); the production ExperimentConfig default is 500 since
+        # round 5 (utils/config.py, RESULTS.md §4). The effective batch is
         # stamped as "eval_batch" in the returned scalars either way.
         self._require_compiled()
         return ev.training_statistics(self.params, self.cfg,

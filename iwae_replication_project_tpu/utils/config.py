@@ -74,13 +74,15 @@ class ExperimentConfig:
     # computed under; pre-r4 artifacts (chunk 100) carry it in their
     # checkpoint config.json instead.
     nll_chunk: int = 250
-    # 200 since round 4: +22% fused-eval throughput over 100 (measured sweep,
-    # RESULTS.md §4; 250+ regress or exceed the Pallas kernel's VMEM and fall
-    # back to the unfused path). Like nll_chunk, the eval batch versions the
-    # per-batch eval RNG folding — every metrics.jsonl row stamps the
-    # effective `eval_batch`; pre-r4 artifacts ran at 100 (in their
-    # checkpoint config.json).
-    eval_batch_size: int = 200
+    # 500 since round 5 (was 200 in r4, 100 before): the r5 sweep under the
+    # bf16 default measured 13.3k img/s at 500 vs 12.2k at 200 (+9%,
+    # RESULTS.md §4) — batches past the Pallas kernel's forward VMEM gate
+    # run the unfused XLA path, and above ~400 the fewer/larger dispatches
+    # win over the fused small-batch path; 2500+ regresses again. Like
+    # nll_chunk, the eval batch versions the per-batch eval RNG folding —
+    # every metrics.jsonl row stamps the effective `eval_batch`; older
+    # artifacts carry their value in their checkpoint config.json.
+    eval_batch_size: int = 500
     activity_samples: int = 1000
 
     # execution

@@ -34,6 +34,7 @@ K = 50
 EVAL_N = 10000
 EVAL_K = 5000
 EVAL_CHUNK = 250
+EVAL_BATCH = 500  # the production eval_batch_size default (utils/config.py)
 
 
 def _capture(tag: str, out_root: str, fn) -> str:
@@ -127,7 +128,8 @@ def main(argv=None):
         np.asarray(l2)
 
     xe = jnp.asarray((np.random.RandomState(1).rand(EVAL_N, 784) > 0.5)
-                     .astype(np.float32)).reshape(EVAL_N // BATCH, BATCH, 784)
+                     .astype(np.float32)).reshape(EVAL_N // EVAL_BATCH,
+                                                  EVAL_BATCH, 784)
     ekey = jax.random.PRNGKey(1)
     np.asarray(dataset_scalars(state.params, cfg, ekey, xe, K, EVAL_K,
                                EVAL_CHUNK))  # warm
