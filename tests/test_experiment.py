@@ -160,28 +160,35 @@ class TestRunExperiment:
             assert abs(ra["NLL"] - rb["NLL"]) < 1e-3, (ra["NLL"], rb["NLL"])
 
     @pytest.mark.slow
-    def test_mid_stage_kill_resume_bit_identical(self, tmp_path, monkeypatch):
+    @pytest.mark.parametrize("mesh_kw", [{}, dict(mesh_dp=4, mesh_sp=2, k=4,
+                                                  batch_size=32)],
+                             ids=["single-device", "mesh-dp4-sp2"])
+    def test_mid_stage_kill_resume_bit_identical(self, tmp_path, monkeypatch,
+                                                 mesh_kw):
         """Preemption mid-stage must lose at most checkpoint_every_passes
         passes: kill the run right after an intra-stage save, resume, and the
         final state must be BIT-identical to an uninterrupted run (the
         whole-epoch scan carries the RNG key, so the pass stream is exactly
-        reproducible regardless of where it was cut; VERDICT r4 #2)."""
+        reproducible regardless of where it was cut; VERDICT r4 #2). The
+        mesh variant additionally covers Orbax round-tripping the replicated
+        state and the sharded epoch scan's key threading."""
         import iwae_replication_project_tpu.experiment as exp
 
         # uninterrupted reference (3 stages: 1+3+9 passes)
         cfgA = tiny_config(tmp_path, n_stages=3, resume=False,
                            save_figures=False,
                            log_dir=str(tmp_path / "runsA"),
-                           checkpoint_dir=str(tmp_path / "ckptA"))
+                           checkpoint_dir=str(tmp_path / "ckptA"), **mesh_kw)
         stateA, histA = run_experiment(cfgA, max_batches_per_pass=2,
                                        eval_subset=32)
 
         # interrupted run: save every 2 passes, die right after the 5th save
-        # (= stage 3, 4 of 9 passes done — mid-stage)
+        # (stage1-end, s2-pass2, s2-end, s3-pass2, s3-pass4 -> stage 3 with
+        # 4 of 9 passes done — mid-stage)
         cfgB = tiny_config(tmp_path, n_stages=3, save_figures=False,
                            checkpoint_every_passes=2,
                            log_dir=str(tmp_path / "runsB"),
-                           checkpoint_dir=str(tmp_path / "ckptB"))
+                           checkpoint_dir=str(tmp_path / "ckptB"), **mesh_kw)
         real_save = exp.save_checkpoint
         calls = {"n": 0}
 
@@ -196,7 +203,9 @@ class TestRunExperiment:
             run_experiment(cfgB, max_batches_per_pass=2, eval_subset=32)
         monkeypatch.setattr(exp, "save_checkpoint", real_save)
 
-        # resume: must continue at stage 3, pass 5
+        # resume: must continue at stage 3, pass 5 — NOT fall back to the
+        # end-of-stage-2 checkpoint (which would reproduce the final state
+        # too, but lose the mid-stage work this feature exists to keep)
         import io
         from contextlib import redirect_stdout
         buf = io.StringIO()
