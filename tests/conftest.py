@@ -39,3 +39,35 @@ def devices():
 @pytest.fixture
 def rng():
     return jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def preempt_after():
+    """Shared preemption simulator for the mid-stage kill/resume tests:
+    ``with preempt_after(n): run_experiment(...)`` lets the n-th
+    experiment.save_checkpoint call COMPLETE, then raises KeyboardInterrupt
+    — i.e. the process dies right after a durable save, the contract the
+    intra-stage checkpointing feature (checkpoint_every_passes) must
+    survive. One definition so the kill-point arithmetic lives in one
+    place."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm(n: int):
+        import iwae_replication_project_tpu.experiment as exp
+        real = exp.save_checkpoint
+        calls = {"n": 0}
+
+        def dying(*a, **kw):
+            real(*a, **kw)
+            calls["n"] += 1
+            if calls["n"] == n:
+                raise KeyboardInterrupt("simulated preemption")
+
+        exp.save_checkpoint = dying
+        try:
+            yield calls
+        finally:
+            exp.save_checkpoint = real
+
+    return _cm
