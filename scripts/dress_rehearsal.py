@@ -17,12 +17,18 @@ decoder bias follows the reference's raw-means policy
 (flexible_IWAE.py:150-155). NLLs are NOT comparable to the 84.77 north star;
 the wall-clock and per-stage timing table are the deliverables.
 
-Run:  python scripts/dress_rehearsal.py [--checkpoint-every-passes N]
+Round 5 extension: ``--dataset {binarized_mnist,omniglot,fashion_mnist}``
+rehearses every reference data pipeline at its real scale — Omniglot via a
+Burda-split-sized ``chardata.mat`` (24,345/8,070, per-epoch stochastic
+binarization on device) and Fashion-MNIST via the 60k/10k idx pair — same
+2L IWAE k=50 flagship, same full protocol.
+
+Run:  python scripts/dress_rehearsal.py [--dataset D] [--checkpoint-every-passes N]
 Output: per-stage table + one JSON summary line (written to
-results/dress_rehearsal.json ONLY when this process measured all stages
-fresh — a resumed/partial run prints its table but leaves the committed
-measurement alone); fixture files land in data/rehearsal/ (gitignored,
-~95 MB, reused across runs).
+results/dress_rehearsal[_<dataset>].json ONLY when this process measured
+all stages fresh — a resumed/partial run prints its table but leaves the
+committed measurement alone); fixture files land in data/rehearsal/
+(gitignored, reused across runs).
 """
 
 from __future__ import annotations
@@ -39,54 +45,101 @@ import numpy as np  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DATA_DIR = os.path.join(REPO, "data", "rehearsal")
-OUT_JSON = os.path.join(REPO, "results", "dress_rehearsal.json")
 
-N_TRAIN, N_TEST = 50_000, 10_000
+#: real dataset sizes (train, test): MNIST per the Larochelle split, Omniglot
+#: per the Burda chardata.mat split, Fashion-MNIST per its idx files
+SIZES = {"binarized_mnist": (50_000, 10_000),
+         "omniglot": (24_345, 8_070),
+         "fashion_mnist": (60_000, 10_000)}
 
 
-def make_fixture_files(data_dir: str = DATA_DIR) -> float:
-    """Write the real-size reference-format files (idempotent); returns the
-    generation seconds (0.0 when already present)."""
+def out_json(dataset: str) -> str:
+    suffix = "" if dataset == "binarized_mnist" else f"_{dataset}"
+    return os.path.join(REPO, "results", f"dress_rehearsal{suffix}.json")
+
+
+def make_fixture_files(dataset: str, data_dir: str = DATA_DIR) -> float:
+    """Write the real-size reference-format files for `dataset` (idempotent);
+    returns the generation seconds (0.0 when already present)."""
     from iwae_replication_project_tpu.data.loaders import _synthetic
     from tests.fixture_io import write_idx_gz
 
-    train_p = os.path.join(data_dir, "binarized_mnist_train.amat")
-    test_p = os.path.join(data_dir, "binarized_mnist_test.amat")
-    raw_tr_p = os.path.join(data_dir, "train-images-idx3-ubyte.gz")
-    raw_te_p = os.path.join(data_dir, "t10k-images-idx3-ubyte.gz")
-    paths = (train_p, test_p, raw_tr_p, raw_te_p)
-    if all(os.path.exists(p) for p in paths):
-        return 0.0
+    n_train, n_test = SIZES[dataset]
     t0 = time.perf_counter()
-    os.makedirs(data_dir, exist_ok=True)
-    x_train, x_test = _synthetic("binarized_mnist", N_TRAIN, N_TEST, seed=0)
-    # Larochelle .amat: one "%d %d ... %d" line per image
-    np.savetxt(train_p, x_train, fmt="%d")
-    np.savetxt(test_p, x_test, fmt="%d")
-    # raw grayscale (the probabilities scaled to [0,255]) for the raw-means
-    # bias policy — the loader requires the train/t10k idx PAIR
-    gray_tr, gray_te = _synthetic("binarized_mnist", N_TRAIN, N_TEST, seed=0,
-                                  binary=False)
-    write_idx_gz(raw_tr_p, (gray_tr * 255).astype(np.uint8).reshape(-1, 28, 28))
-    write_idx_gz(raw_te_p, (gray_te * 255).astype(np.uint8).reshape(-1, 28, 28))
+    if dataset == "binarized_mnist":
+        train_p = os.path.join(data_dir, "binarized_mnist_train.amat")
+        test_p = os.path.join(data_dir, "binarized_mnist_test.amat")
+        raw_tr_p = os.path.join(data_dir, "train-images-idx3-ubyte.gz")
+        raw_te_p = os.path.join(data_dir, "t10k-images-idx3-ubyte.gz")
+        if all(os.path.exists(p) for p in (train_p, test_p, raw_tr_p,
+                                           raw_te_p)):
+            return 0.0
+        os.makedirs(data_dir, exist_ok=True)
+        x_train, x_test = _synthetic(dataset, n_train, n_test, seed=0)
+        # Larochelle .amat: one "%d %d ... %d" line per image
+        np.savetxt(train_p, x_train, fmt="%d")
+        np.savetxt(test_p, x_test, fmt="%d")
+        # raw grayscale (the probabilities scaled to [0,255]) for the
+        # raw-means bias policy — the loader requires the train/t10k PAIR
+        gray_tr, gray_te = _synthetic(dataset, n_train, n_test, seed=0,
+                                      binary=False)
+        write_idx_gz(raw_tr_p,
+                     (gray_tr * 255).astype(np.uint8).reshape(-1, 28, 28))
+        write_idx_gz(raw_te_p,
+                     (gray_te * 255).astype(np.uint8).reshape(-1, 28, 28))
+    elif dataset == "omniglot":
+        # the Burda-split chardata.mat the reference downloads
+        # (flexible_IWAE.py:164-165): "data"/"testdata" as [784, N]
+        # grayscale in [0,1]; the protocol re-binarizes per epoch on device
+        p = os.path.join(data_dir, "chardata.mat")
+        if os.path.exists(p):
+            return 0.0
+        os.makedirs(data_dir, exist_ok=True)
+        import scipy.io as sio
+        gray_tr, gray_te = _synthetic(dataset, n_train, n_test, seed=0,
+                                      binary=False)
+        sio.savemat(p, {"data": gray_tr.T.astype(np.float32),
+                        "testdata": gray_te.T.astype(np.float32)})
+    elif dataset == "fashion_mnist":
+        sub = os.path.join(data_dir, "fashion_mnist")
+        tr = os.path.join(sub, "train-images-idx3-ubyte.gz")
+        te = os.path.join(sub, "t10k-images-idx3-ubyte.gz")
+        if os.path.exists(tr) and os.path.exists(te):
+            return 0.0
+        os.makedirs(sub, exist_ok=True)
+        gray_tr, gray_te = _synthetic(dataset, n_train, n_test, seed=0,
+                                      binary=False)
+        write_idx_gz(tr, (gray_tr * 255).astype(np.uint8).reshape(-1, 28, 28))
+        write_idx_gz(te, (gray_te * 255).astype(np.uint8).reshape(-1, 28, 28))
+    else:
+        raise ValueError(f"no rehearsal fixtures for dataset {dataset!r}")
     return time.perf_counter() - t0
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="binarized_mnist",
+                    choices=sorted(SIZES),
+                    help="which reference data pipeline to rehearse at real "
+                         "file sizes (binarized_mnist = the .amat + raw-idx "
+                         "north-star path; omniglot = chardata.mat with "
+                         "per-epoch stochastic binarization; fashion_mnist "
+                         "= the idx pair, also stochastic)")
     ap.add_argument("--checkpoint-every-passes", type=int, default=200)
     ap.add_argument("--data-dir", default=DATA_DIR)
     ap.add_argument("--fresh", action="store_true",
                     help="ignore existing checkpoints (default resumes)")
     args = ap.parse_args(argv)
+    n_train, n_test = SIZES[args.dataset]
 
-    gen_s = make_fixture_files(args.data_dir)
+    gen_s = make_fixture_files(args.dataset, args.data_dir)
     print(f"fixture files: {args.data_dir} (generation {gen_s:.1f}s)")
 
     from iwae_replication_project_tpu import zoo
     from iwae_replication_project_tpu.experiment import run_experiment
 
-    cfg = zoo.get("northstar-iwae-2l-k50")
+    cfg = zoo.get("northstar-iwae-2l-k50")  # the 2L flagship, IWAE k=50
+    cfg.dataset = args.dataset
     cfg.data_dir = args.data_dir
     cfg.allow_synthetic = False  # the files MUST be found — that is the test
     cfg.log_dir = os.path.join(REPO, "runs", "dress_rehearsal")
@@ -114,7 +167,7 @@ def main(argv=None):
     for res, _ in history:
         st = int(res["stage"])
         passes = lengths[st]
-        steps = passes * (N_TRAIN // cfg.batch_size)
+        steps = passes * (n_train // cfg.batch_size)
         tr = res.get("stage_train_seconds", float("nan"))
         ev = res.get("stage_eval_seconds", float("nan"))
         rows.append({"stage": st, "passes": passes,
@@ -124,10 +177,13 @@ def main(argv=None):
         print(f"{st:>5} {passes:>6} {tr:>9.1f} {ev:>8.1f} "
               f"{steps / tr:>9.1f} {res['NLL']:>9.3f}")
 
+    dest = out_json(args.dataset)
     summary = {
-        "metric": "northstar-iwae-2l-k50 dress rehearsal "
-                  "(synthetic data at real MNIST file sizes)",
-        "n_train": N_TRAIN, "n_test": N_TEST,
+        "metric": f"2L IWAE k=50 dress rehearsal on {args.dataset} "
+                  f"(synthetic data at real file sizes)",
+        "n_train": n_train, "n_test": n_test,
+        "binarization": "fixed" if args.dataset == "binarized_mnist"
+        else "stochastic (per-epoch, on device)",
         "total_seconds": round(total_s, 1),
         "fixture_generation_seconds": round(gen_s, 1),
         "checkpoint_every_passes": args.checkpoint_every_passes,
@@ -137,15 +193,15 @@ def main(argv=None):
     complete = not resumed and len(rows) == cfg.n_stages
     if complete:
         try:
-            with open(OUT_JSON, "w") as f:
+            with open(dest, "w") as f:
                 json.dump(summary, f, indent=1)
-            print(f"wrote {OUT_JSON}")
+            print(f"wrote {dest}")
         except OSError:
             pass
     else:
         print(f"partial/resumed run ({len(rows)}/{cfg.n_stages} stages "
               f"measured{', resumed' if resumed else ''}): NOT overwriting "
-              f"{OUT_JSON}; rerun with --fresh for a full measurement")
+              f"{dest}; rerun with --fresh for a full measurement")
 
 
 if __name__ == "__main__":
