@@ -244,12 +244,14 @@ class FlexibleModel:
         meta = {"paths": keys, "arch": self._arch_descr(), "format": 1}
         arrays = {f"leaf_{i}": np.asarray(a) for i, a in enumerate(flat)}
         if path.endswith(".pkl"):  # old-API callers: keep the round-trip
-            if os.path.exists(path):
-                # the old API would have overwritten this file; left in place
-                # it would shadow the fresh .npz on the next load
-                os.remove(path)
             path = path[:-len(".pkl")]
         out = path if path.endswith(".npz") else path + ".npz"
+        # the old API wrote (and would have overwritten) `<stem>.pkl`; left
+        # in place it would shadow this fresh .npz on a later
+        # load_weights("<stem>.pkl") — remove it for BOTH save spellings
+        stale = out[:-len(".npz")] + ".pkl"
+        if os.path.exists(stale):
+            os.remove(stale)
         with open(out, "wb") as f:
             np.savez(f, __meta__=np.frombuffer(
                 json.dumps(meta).encode(), dtype=np.uint8), **arrays)

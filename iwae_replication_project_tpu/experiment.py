@@ -254,8 +254,12 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         res["raw_means_bias"] = ds.bias_source == "raw"
         res["bfloat16"] = cfg.compute_dtype == "bfloat16"
         # wall-clock per stage (train = the passes incl. checkpoint saves,
-        # eval = the full statistics suite), for capacity planning
+        # eval = the full statistics suite), for capacity planning. After a
+        # mid-stage resume the timer only saw `passes - offset` passes —
+        # stage_passes_timed records that so steps/s derived from these
+        # fields stays honest (scripts/dress_rehearsal.py uses it).
         res["stage_train_seconds"] = round(train_s, 3)
+        res["stage_passes_timed"] = float(passes - offset)
         res["stage_eval_seconds"] = round(time.perf_counter() - t_eval, 3)
         # `res` already carries "nll_chunk" — the EFFECTIVE chunk the eval
         # driver used (clamped per device under sp) — as the eval-RNG version

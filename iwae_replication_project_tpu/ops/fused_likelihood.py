@@ -101,10 +101,13 @@ def fits_vmem(k: int, b: int, hdim: int, n_pixels: int,
     p_pad = _pixel_pad(n_pixels)
     tk = min(TILE_K, k)
     if grad:
-        # f32: logits + dlogits + g_rows tiles, dh out, dW/db accumulators
-        est = 4 * (3 * tk * b * p_pad + tk * b * hdim + hdim * p_pad + p_pad)
-        # operand blocks: h, w, x, g
-        est += itemsize * (tk * b * hdim + hdim * p_pad + b * p_pad + tk * b)
+        # f32: logits + dlogits + g_rows tiles, dh out, dW/db accumulators,
+        # and the g cotangent block (always f32 — the kernel's out dtype,
+        # matching _probe_compiles' arg construction)
+        est = 4 * (3 * tk * b * p_pad + tk * b * hdim + hdim * p_pad + p_pad
+                   + tk * b)
+        # operand blocks: h, w, x
+        est += itemsize * (tk * b * hdim + hdim * p_pad + b * p_pad)
     else:
         # f32: logits tile + out rows; operands: h, w, x
         est = 4 * (tk * b * p_pad + tk * b)

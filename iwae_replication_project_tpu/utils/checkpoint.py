@@ -131,7 +131,8 @@ def restore_latest(directory: str, template: TrainState, *,
             stored_dt = json.loads(meta.get("config", "") or "{}")
             cur_dt = json.loads(expect_config_json)
             if isinstance(stored_dt, dict) and isinstance(cur_dt, dict) \
-                    and stored_dt.get("compute_dtype") != cur_dt.get("compute_dtype"):
+                    and stored_dt.get("compute_dtype") != cur_dt.get("compute_dtype") \
+                    and jax.process_index() == 0:
                 print(f"note: checkpoint was trained with compute_dtype="
                       f"{stored_dt.get('compute_dtype')!r}; resuming under "
                       f"compute_dtype={cur_dt.get('compute_dtype')!r} — the "

@@ -118,6 +118,10 @@ class ExperimentConfig:
             raise ValueError(
                 f"compute_dtype must be None, 'float32' or 'bfloat16', got "
                 f"{self.compute_dtype!r}")
+        if self.checkpoint_every_passes < 0:
+            raise ValueError(
+                f"checkpoint_every_passes must be >= 0 (0 = stage boundaries "
+                f"only), got {self.checkpoint_every_passes}")
     # "logits" is the exact Bernoulli log-likelihood x*l - softplus(l) — the
     # fast path bench.py measures, and the default since round 3 (NLL-
     # neutrality vs "clamp" on a trained model is asserted by

@@ -167,10 +167,14 @@ def main(argv=None):
     for res, _ in history:
         st = int(res["stage"])
         passes = lengths[st]
-        steps = passes * (n_train // cfg.batch_size)
+        # after a mid-stage resume the timer only covered the remaining
+        # passes — use the stamped count, not the full stage length
+        timed = int(res.get("stage_passes_timed", passes))
+        steps = timed * (n_train // cfg.batch_size)
         tr = res.get("stage_train_seconds", float("nan"))
         ev = res.get("stage_eval_seconds", float("nan"))
         rows.append({"stage": st, "passes": passes,
+                     "passes_timed": timed,
                      "train_seconds": tr, "eval_seconds": ev,
                      "steps_per_sec": round(steps / tr, 1) if tr else None,
                      "NLL": round(res["NLL"], 3)})

@@ -47,6 +47,14 @@ ARCH_2L = dict(n_hidden_encoder=(200, 100), n_hidden_decoder=(100, 200),
                n_latent_encoder=(100, 50), n_latent_decoder=(100, 784))
 
 
+#: the committed evidence suite stays pinned to f32: its artifacts
+#: (results/runs/*, summary.json, the RESULTS.md tables) were produced under
+#: the pre-r5 default, and a rerun must regenerate THOSE numbers — not
+#: append bf16 rows under the same run names. bf16 evidence has its own
+#: artifact (--bf16-study -> summary_seeds_scaled_bf16.json).
+_SUITE_DTYPE = "float32"
+
+
 def replication_suite(n_stages: int = 8):
     """The run list. Names key the summary table in RESULTS.md."""
     runs = []
@@ -109,6 +117,11 @@ def replication_suite(n_stages: int = 8):
             dataset="digits_gray", allow_synthetic=False, loss_function=loss,
             k=k, n_stages=n_stages, eval_batch_size=99, save_figures=False,
             log_dir=RESULTS_DIR, checkpoint_dir="checkpoints", **ARCH_1L)))
+    for _, cfg in runs:
+        cfg.compute_dtype = _SUITE_DTYPE
+        cfg.__post_init__()  # normalizes "float32" -> None (= the committed
+        # artifacts' stored value, so resume identity and the dtype-drift
+        # note behave exactly as before the r5 default flip)
     return runs
 
 
@@ -143,7 +156,10 @@ def seed_study(seeds=(1, 2), n_stages: int = 8, passes_scale: float = 1.0,
                                  loss_function=loss, k=k, seed=seed,
                                  n_stages=n_stages, eval_batch_size=99,
                                  passes_scale=passes_scale,
-                                 compute_dtype=compute_dtype,
+                                 # None = the committed f32 protocol, which
+                                 # must keep regenerating its own numbers
+                                 # after the r5 bf16 default flip
+                                 compute_dtype=compute_dtype or _SUITE_DTYPE,
                                  save_figures=False, log_dir=log_dir,
                                  checkpoint_dir=ckpt_dir, **arch)))
     return runs
@@ -164,6 +180,7 @@ def torch_cross_check(n_stages: int = 5, loss: str = "IWAE",
     base = dict(dataset="digits", allow_synthetic=False, loss_function=loss,
                 k=5, n_stages=n_stages, eval_batch_size=99, nll_k=500,
                 save_figures=False, resume=False,
+                compute_dtype=_SUITE_DTYPE,  # committed artifacts are f32
                 log_dir="results/cross_check",
                 checkpoint_dir="checkpoints/cross_check", **ARCH_1L)
     out = {}

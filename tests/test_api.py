@@ -193,6 +193,11 @@ class TestJaxSurface:
         model.save_weights(path)
         assert (tmp_path / "w.npz").exists()
         assert not (tmp_path / "w.pkl").exists()
+        # ... and the BARE-path save spelling must clear the stale sibling
+        # too: otherwise load_weights('w.pkl') would resurrect it
+        (tmp_path / "w.pkl").write_bytes(b"stale")
+        model.save_weights(str(tmp_path / "w"))
+        assert not (tmp_path / "w.pkl").exists()
         other = build("jax", loss_function="IWAE", k=8, seed=123).compile()
         other.load_weights(path)
         jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a),
