@@ -220,6 +220,10 @@ def main(argv=None):
                     help="with --seed-study: use the principled scaled "
                          "schedule (passes_scale=0.2, seeds incl. 0; summary "
                          "lands in results/summary_seeds_scaled.json)")
+    from iwae_replication_project_tpu.utils.config import _int_list
+    ap.add_argument("--seeds", default=None, type=_int_list,
+                    help="comma-separated seed list for --seed-study / "
+                         "--bf16-study (default 0,1,2 scaled / 1,2 unscaled)")
     ap.add_argument("--bf16-study", action="store_true",
                     help="the scaled seed study under compute_dtype=bfloat16 "
                          "(VERDICT r4 #4: convergence evidence for the bf16 "
@@ -243,20 +247,30 @@ def main(argv=None):
                  "at a time")
     if ns.check_loss and not (ns.torch_check or ns.tf2_check):
         ap.error("--check-loss only applies to --torch-check / --tf2-check")
+    if ns.seeds is not None and not (ns.seed_study or ns.bf16_study):
+        ap.error("--seeds only applies to --seed-study / --bf16-study")
     if ns.torch_check or ns.tf2_check:
         torch_cross_check(loss=ns.check_loss or "IWAE",
                           eager_backend="tf2" if ns.tf2_check else "torch")
         return
 
     n_stages = 3 if ns.quick else 8
+    seeds = ns.seeds
     if ns.bf16_study:
-        suite = seed_study(seeds=(0, 1, 2), n_stages=n_stages,
+        suite = seed_study(seeds=seeds or (0, 1, 2), n_stages=n_stages,
                            passes_scale=0.2, compute_dtype="bfloat16")
     elif ns.seed_study and ns.scaled:
-        suite = seed_study(seeds=(0, 1, 2), n_stages=n_stages,
+        suite = seed_study(seeds=seeds or (0, 1, 2), n_stages=n_stages,
                            passes_scale=0.2)
     elif ns.seed_study:
-        suite = seed_study(n_stages=n_stages)
+        # seed 0 at passes_scale=1.0 IS the main suite's science identity
+        # (same run names/dirs) — the unscaled study must not collide with
+        # the committed runs, which the old hardcoded (1,2) guaranteed
+        seeds = tuple(s for s in (seeds or (1, 2)) if s != 0)
+        if not seeds:
+            ap.error("unscaled --seed-study cannot run seed 0 (it is the "
+                     "main suite's identity); pass --scaled or other seeds")
+        suite = seed_study(seeds=seeds, n_stages=n_stages)
     else:
         suite = replication_suite(n_stages)
     summary = []
