@@ -173,22 +173,35 @@ def _train_rates(cfg, reps=REPS):
     multi-pass batching the experiment driver uses for the long Burda stages
     (experiment.py PASS_BLOCK=27; 5 here is conservative). Through round 4
     the bench dispatched per-epoch, paying 4 extra ~10-15 ms tunnel
-    round-trips per rep that the production driver does not pay."""
+    round-trips per rep that the production driver does not pay.
+
+    The program goes through the warm-path AOT registry exactly like the
+    driver's, so the returned `compile_info` cleanly separates compile from
+    execute time: `aot_compile_seconds` is the lower+compile wall (collapsing
+    to cache-deserialization on a warm start) and `persistent_cache_misses`
+    counts true XLA recompiles (0 when the persistent cache is warm).
+    """
     import jax
     import jax.numpy as jnp
 
     from iwae_replication_project_tpu.objectives import ObjectiveSpec
     from iwae_replication_project_tpu.training import create_train_state
     from iwae_replication_project_tpu.training.epoch import make_epoch_fn
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        cache_stats, stats_delta, warm_callable)
 
     spec = ObjectiveSpec("IWAE", k=K)
     state = create_train_state(jax.random.PRNGKey(0), cfg)
     epoch = make_epoch_fn(spec, cfg, N_TRAIN, BATCH, donate=False,
                           epochs_per_call=EPOCHS)
+    epoch = warm_callable("bench_epoch", epoch,
+                          build_key=(spec, cfg, N_TRAIN, BATCH, EPOCHS))
     x = jnp.asarray(make_data(N_TRAIN))
 
+    s0 = cache_stats()
     state, losses = epoch(state, x)   # compile + warmup
     np.asarray(losses)                # sync
+    compile_info = stats_delta(s0)
     steps = EPOCHS * (N_TRAIN // BATCH)
     rates = []
     for _ in range(reps):
@@ -196,7 +209,7 @@ def _train_rates(cfg, reps=REPS):
         state, losses = epoch(state, x)
         np.asarray(losses)            # honest completion sync
         rates.append(steps / (time.perf_counter() - t0))
-    return rates, state
+    return rates, state, compile_info
 
 
 def bench_jax():
@@ -210,11 +223,11 @@ def bench_jax():
     # since round 5 (utils/config.py, RESULTS.md §2b)
     cfg = ModelConfig.two_layer(likelihood="logits", fused_likelihood=on_tpu,
                                 compute_dtype="bfloat16")
-    rates, state = _train_rates(cfg)
+    rates, state, compile_info = _train_rates(cfg)
     # secondary datapoint: full-f32 matmuls (the pre-r5 default)
     cfg_f32 = ModelConfig.two_layer(likelihood="logits",
                                     fused_likelihood=on_tpu)
-    rates_f32, _ = _train_rates(cfg_f32, reps=1)
+    rates_f32, _, _ = _train_rates(cfg_f32, reps=1)
 
     # eval path: the full per-batch scalar suite (VAE/IWAE bounds at k=50,
     # streaming k=5000 NLL, recon BCE) over EVAL_N images as ONE fused
@@ -232,7 +245,7 @@ def bench_jax():
         np.asarray(dataset_scalars(state.params, cfg, key, xe, K,
                                    EVAL_K, EVAL_CHUNK))
         eval_rates.append(EVAL_N / (time.perf_counter() - t0))
-    return rates, rates_f32, eval_rates
+    return rates, rates_f32, eval_rates, compile_info
 
 
 def bench_baseline() -> tuple:
@@ -265,12 +278,142 @@ def bench_baseline() -> tuple:
     return sps, BASELINE_ITERS
 
 
+MEMORY_CASES = ("flagship_train_dispatch", "eval_suite",
+                "widest_scaling_shape")
+
+
+def _memory_case(case: str) -> dict:
+    """Run one ``--memory`` case in THIS process and return its row.
+
+    ``peak_bytes_in_use`` is a process-lifetime high-water mark with no reset
+    API, so each case must run in a fresh process (bench_memory spawns one
+    per case) — otherwise every later row would just repeat the max over all
+    earlier cases.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from iwae_replication_project_tpu.evaluation.metrics import dataset_scalars
+    from iwae_replication_project_tpu.objectives import ObjectiveSpec
+    from iwae_replication_project_tpu.training import create_train_state
+    from iwae_replication_project_tpu.training.epoch import make_epoch_fn
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    dev = jax.local_devices()[0]
+
+    def stats():
+        try:
+            return dev.memory_stats() or {}
+        except Exception:
+            return {}
+
+    n_train = int(os.environ.get("BENCH_MEMORY_N_TRAIN", N_TRAIN))
+    eval_n = int(os.environ.get("BENCH_MEMORY_EVAL_N", EVAL_N))
+    spec = ObjectiveSpec("IWAE", k=K)
+
+    if case == "flagship_train_dispatch":
+        # the whole-epoch scan with x_train resident in device memory
+        cfg = scaled_config(200, on_tpu, compute_dtype="bfloat16")
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        epoch = make_epoch_fn(spec, cfg, n_train, BATCH, donate=False)
+        state, losses = epoch(state, jnp.asarray(make_data(n_train)))
+        np.asarray(losses)
+        row = {"case": case, "n_train": n_train, "batch": BATCH, "k": K}
+    elif case == "eval_suite":
+        # the production eval suite (batch 500 / chunk 250 / k=5000)
+        cfg = scaled_config(200, on_tpu, compute_dtype="bfloat16")
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        xe = jnp.asarray(make_data(eval_n)).reshape(-1, EVAL_BATCH, 784)
+        np.asarray(dataset_scalars(state.params, cfg, jax.random.PRNGKey(1),
+                                   xe, K, EVAL_K, EVAL_CHUNK))
+        row = {"case": case, "n_images": eval_n, "batch": EVAL_BATCH,
+               "nll_k": EVAL_K, "chunk": EVAL_CHUNK}
+    elif case == "widest_scaling_shape":
+        # the widest scaling-sweep shape (hidden 2048, batch 256, bf16)
+        wide = scaled_config(2048, on_tpu, compute_dtype="bfloat16")
+        state = create_train_state(jax.random.PRNGKey(0), wide)
+        n_wide = min(n_train, 25600)
+        epoch = make_epoch_fn(spec, wide, n_wide, 256, donate=False)
+        state, losses = epoch(state, jnp.asarray(make_data(n_wide)))
+        np.asarray(losses)
+        row = {"case": case, "hidden": 2048, "n_train": n_wide, "batch": 256,
+               "k": K}
+    else:
+        raise ValueError(f"unknown memory case {case!r}")
+
+    s = stats()
+    row["peak_bytes"] = s.get("peak_bytes_in_use")
+    row["bytes_limit"] = s.get("bytes_limit")
+    row["memory_stats_available"] = bool(s)
+    row["device"] = getattr(dev, "device_kind", dev.platform)
+    return row
+
+
+def bench_memory():
+    """``--memory``: peak device-memory accounting for the three production
+    shapes (VERDICT r5 weak #4) — the flagship train dispatch, the
+    batch-500/chunk-250 eval suite, and the widest scaling-sweep shape —
+    plus the replicated-``x_train`` max-dataset bound those peaks imply.
+
+    Each case runs in its own subprocess (true per-case peaks — see
+    :func:`_memory_case`); prints one JSON line. ``memory_stats()`` is a
+    TPU/GPU allocator API; hosts without it (CPU) stamp null peaks but still
+    report the analytic bound (``x_train`` is replicated per device at
+    4 bytes/pixel, so max rows = headroom / (784*4)).
+    """
+    import subprocess
+    import sys
+
+    rows = []
+    for case in MEMORY_CASES:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--memory-case", case],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if r.returncode != 0:
+            raise RuntimeError(f"--memory case {case} failed:\n{r.stderr[-2000:]}")
+        rows.append(json.loads(
+            [ln for ln in r.stdout.splitlines() if ln.startswith("{")][-1]))
+
+    limit = rows[0].get("bytes_limit")
+    train_peak = rows[0].get("peak_bytes")
+    headroom = limit - train_peak if limit and train_peak else None
+    # x_train is replicated per device (parallel/dp.py design note), f32:
+    # the dataset-size ceiling is headroom over the per-row 784*4 bytes
+    bound_rows = headroom // (784 * 4) if headroom else None
+    print(json.dumps({
+        "metric": "peak device memory (production shapes, one process per "
+                  "case) + replicated x_train dataset bound",
+        "memory_stats_available": bool(rows[0].get("memory_stats_available")),
+        "device": rows[0].get("device"),
+        "bytes_limit": limit,
+        "rows": rows,
+        "headroom_after_flagship_train_bytes": headroom,
+        "replicated_x_train_max_rows": bound_rows,
+        "replicated_x_train_bytes_per_row": 784 * 4,
+    }))
+
+
 def main():
     import sys
+
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        cache_stats, setup_persistent_cache)
+
+    # persistent XLA cache for repeated bench runs (same programs every run);
+    # repo-local dir, IWAE_COMPILE_CACHE overrides, "off" disables
+    setup_persistent_cache(base_dir=os.path.dirname(os.path.abspath(__file__)))
+    if "--memory-case" in sys.argv:  # per-case subprocess of bench_memory
+        print(json.dumps(_memory_case(sys.argv[sys.argv.index("--memory-case")
+                                               + 1])))
+        return
+    if "--memory" in sys.argv:
+        bench_memory()
+        return
     if "--scaling" in sys.argv:
         bench_scaling()
         return
-    rates, rates_f32, eval_rates = bench_jax()
+    rates, rates_f32, eval_rates, compile_info = bench_jax()
     base_sps, base_n = bench_baseline()
     mean_sps = float(np.mean(rates))
     f32_sps = float(np.mean(rates_f32))
@@ -300,6 +443,17 @@ def main():
                         "suite": "full per-batch scalar suite"},
         "epochs_per_dispatch": EPOCHS,  # production-cadence batching (r5+;
         # rounds <=4 dispatched per-epoch)
+        # compile vs execute split (warm-path engine, utils/compile_cache.py):
+        # compile_seconds_train is the lower+compile wall of the headline
+        # program (collapses to cache deserialization when the persistent
+        # cache is warm); recompiles counts true XLA compiles during it —
+        # 0 on a warm start
+        "compile_seconds_train": round(
+            float(compile_info["aot_compile_seconds"]), 3),
+        "recompiles_during_warmup": int(
+            compile_info["persistent_cache_misses"]),
+        "cache": {k: (round(v, 3) if isinstance(v, float) else v)
+                  for k, v in cache_stats().items()},
         "mfu": mfu,
         "mfu_f32": mfu_f32,
         # both mfu figures share the bf16 peak denominator (v5e has no

@@ -248,10 +248,18 @@ class FlexibleModel:
         out = path if path.endswith(".npz") else path + ".npz"
         # the old API wrote (and would have overwritten) `<stem>.pkl`; left
         # in place it would shadow this fresh .npz on a later
-        # load_weights("<stem>.pkl") — remove it for BOTH save spellings
+        # load_weights("<stem>.pkl"). It must move aside for BOTH save
+        # spellings — but it may be the only copy of differently-trained
+        # weights, so it is renamed to `<stem>.pkl.bak` (clobbering any older
+        # .bak) rather than deleted, with a warning (ADVICE r5).
         stale = out[:-len(".npz")] + ".pkl"
         if os.path.exists(stale):
-            os.remove(stale)
+            import warnings
+            warnings.warn(
+                f"save_weights: a legacy pickle exists at {stale!r} and would "
+                f"shadow the fresh {out!r} on load; renaming it to "
+                f"{stale + '.bak'!r}", UserWarning, stacklevel=2)
+            os.replace(stale, stale + ".bak")
         with open(out, "wb") as f:
             np.savez(f, __meta__=np.frombuffer(
                 json.dumps(meta).encode(), dtype=np.uint8), **arrays)

@@ -30,6 +30,14 @@ from iwae_replication_project_tpu.training import (
 )
 from iwae_replication_project_tpu.training.train_step import set_learning_rate
 from iwae_replication_project_tpu.utils.checkpoint import restore_latest, save_checkpoint
+from iwae_replication_project_tpu.utils.compile_cache import (
+    cache_stats,
+    donation_safe,
+    mesh_fingerprint,
+    setup_persistent_cache,
+    stats_delta,
+    warm_callable,
+)
 from iwae_replication_project_tpu.utils.config import ExperimentConfig
 from iwae_replication_project_tpu.utils.logging import MetricsLogger
 
@@ -57,6 +65,13 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         from iwae_replication_project_tpu.api import FlexibleModel
         FlexibleModel([1], [1], [1], [1], backend=cfg.backend)
         raise AssertionError("unreachable")
+
+    # warm path: persistent XLA compilation cache under the checkpoint root
+    # (the one directory that survives a preemption), so a resumed run —
+    # or the next stage of this one — pays zero recompiles. Config/env
+    # override or disable it; utils/compile_cache.py is the single owner of
+    # the jax.config wiring (a lint-guard test keeps it that way).
+    setup_persistent_cache(cfg.compile_cache_dir, base_dir=cfg.checkpoint_dir)
 
     is_primary = True
     if cfg.multihost:
@@ -104,7 +119,20 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     # batch PASS_BLOCK passes per dispatch: at small-dataset scale a pass is
     # ~5 ms of device work vs ~10-15 ms of per-dispatch transport, so stage 8
     # (3^7 = 2187 passes) would otherwise spend ~30 s on dispatch alone.
+    #
+    # Each function is AOT-compiled once per (program, arg-signature) via the
+    # module-level executable registry (utils/compile_cache.py): the compiled
+    # executable survives across stages and across run_experiment calls in
+    # this process, and the state buffers are donated to each dispatch
+    # (cfg.donate_buffers) — the old state is dead once the new one returns,
+    # so XLA updates params/Adam moments in place instead of holding both.
     _fn_cache = {}
+    stoch_bin = ds.binarization == "stochastic"
+    # donation_safe(): jaxlib-0.4.x XLA:CPU corrupts memory when donated
+    # programs are deserialized from the persistent cache — on CPU with the
+    # cache active, donation is dropped (see utils/compile_cache.py)
+    donate = cfg.donate_buffers and donation_safe()
+    mesh_key = mesh_fingerprint(mesh)
 
     def epoch_fn_for(active_spec, epochs_per_call=1):
         cache_key = (active_spec, epochs_per_call)
@@ -114,16 +142,21 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
             from iwae_replication_project_tpu.parallel.dp import make_parallel_epoch_fn
             fn = make_parallel_epoch_fn(
                 active_spec, model_cfg, mesh, n_train, cfg.batch_size,
-                stochastic_binarization=ds.binarization == "stochastic",
-                optimizer=opt, donate=False,
+                stochastic_binarization=stoch_bin,
+                optimizer=opt, donate=donate,
                 epochs_per_call=epochs_per_call)
         else:
             from iwae_replication_project_tpu.training.epoch import make_epoch_fn
             fn = make_epoch_fn(
                 active_spec, model_cfg, n_train, cfg.batch_size,
-                stochastic_binarization=ds.binarization == "stochastic",
-                optimizer=opt, donate=False,
+                stochastic_binarization=stoch_bin,
+                optimizer=opt, donate=donate,
                 epochs_per_call=epochs_per_call)
+        fn = warm_callable(
+            "parallel_epoch" if mesh is not None else "epoch", fn,
+            build_key=(active_spec, model_cfg, epochs_per_call, n_train,
+                       cfg.batch_size, stoch_bin, donate,
+                       cfg.adam_eps, mesh_key))
         _fn_cache[cache_key] = fn
         return fn
 
@@ -191,18 +224,25 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         offset = start_offset if stage == start_stage else 0
         done = offset          # passes completed within this stage
         since_save = 0         # passes since the last intra-stage checkpoint
+        ckpt_s = 0.0           # seconds inside mid-stage checkpoint saves
+        stage_stats0 = cache_stats()
 
         def maybe_save_mid_stage():
             # save at dispatch boundaries once >= checkpoint_every_passes
             # passes have accumulated — but never for the final boundary,
-            # which the end-of-stage save below covers
-            nonlocal since_save
+            # which the end-of-stage save below covers. The save (incl. its
+            # pipeline-draining fetch) is timed separately so
+            # stage_train_seconds / derived steps-per-sec stay comparable
+            # across --checkpoint-every-passes cadences (ADVICE r5).
+            nonlocal since_save, ckpt_s
             if cfg.checkpoint_every_passes \
                     and since_save >= cfg.checkpoint_every_passes \
                     and done < passes:
+                t_ck = time.perf_counter()
                 save_checkpoint(ckpt_dir, int(fetch(state.step)), state, stage,
                                 config_json=cfg.to_json(),
                                 keep=cfg.checkpoint_keep, passes_done=done)
+                ckpt_s += time.perf_counter() - t_ck
                 since_save = 0
 
         t_train = time.perf_counter()
@@ -253,14 +293,28 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         res["synthetic_data"] = bool(ds.synthetic)
         res["raw_means_bias"] = ds.bias_source == "raw"
         res["bfloat16"] = cfg.compute_dtype == "bfloat16"
-        # wall-clock per stage (train = the passes incl. checkpoint saves,
-        # eval = the full statistics suite), for capacity planning. After a
-        # mid-stage resume the timer only saw `passes - offset` passes —
+        # wall-clock per stage (train = the passes, with mid-stage checkpoint
+        # saves broken out into stage_checkpoint_seconds so steps/s stays
+        # comparable across --checkpoint-every-passes cadences; eval = the
+        # full statistics suite), for capacity planning. After a mid-stage
+        # resume the timer only saw `passes - offset` passes —
         # stage_passes_timed records that so steps/s derived from these
         # fields stays honest (scripts/dress_rehearsal.py uses it).
-        res["stage_train_seconds"] = round(train_s, 3)
+        res["stage_train_seconds"] = round(train_s - ckpt_s, 3)
+        res["stage_checkpoint_seconds"] = round(ckpt_s, 3)
         res["stage_passes_timed"] = float(passes - offset)
         res["stage_eval_seconds"] = round(time.perf_counter() - t_eval, 3)
+        # warm-path accounting for THIS stage (utils/compile_cache.py): how
+        # many programs the AOT registry reused vs newly compiled, and the
+        # XLA compile seconds paid. A warm start (persistent cache populated)
+        # shows compile_cache_misses == 0 from stage 1 onward.
+        d_stats = stats_delta(stage_stats0)
+        res["aot_hits"] = float(d_stats["aot_hits"])
+        res["aot_misses"] = float(d_stats["aot_misses"])
+        res["aot_compile_seconds"] = round(d_stats["aot_compile_seconds"], 3)
+        res["compile_cache_misses"] = float(d_stats["persistent_cache_misses"])
+        res["compile_cache_hits"] = float(d_stats["persistent_cache_hits"])
+        res["compile_seconds"] = round(d_stats["backend_compile_seconds"], 3)
         # `res` already carries "nll_chunk" — the EFFECTIVE chunk the eval
         # driver used (clamped per device under sp) — as the eval-RNG version
         if is_primary:

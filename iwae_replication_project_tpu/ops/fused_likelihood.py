@@ -140,7 +140,10 @@ def kernel_usable(k: int, b: int, hdim: int, n_pixels: int, *,
         return False
     if interpret:
         return True
-    key = (k, b, hdim, n_pixels, grad, dtype.name)
+    # the effective budget is part of the key: a mid-process change to
+    # IWAE_FUSED_VMEM_BUDGET must invalidate earlier probe verdicts, not
+    # silently keep the decision made under the old budget (ADVICE r5)
+    key = (k, b, hdim, n_pixels, grad, dtype.name, _vmem_budget())
     hit = _probe_cache.get(key)
     if hit is None:
         hit = _probe_compiles(k, b, hdim, n_pixels, grad, dtype)

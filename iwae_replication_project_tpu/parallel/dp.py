@@ -23,7 +23,6 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from iwae_replication_project_tpu.models import iwae as model
 from iwae_replication_project_tpu.objectives import (
@@ -31,7 +30,7 @@ from iwae_replication_project_tpu.objectives import (
     estimators as est,
     objective_value_and_grad,
 )
-from iwae_replication_project_tpu.parallel.mesh import AXES
+from iwae_replication_project_tpu.parallel.mesh import AXES, shard_map
 from iwae_replication_project_tpu.training.train_step import TrainState, make_adam
 
 #: every objective supports sp (k-axis) sharding. Most decompose via a global
@@ -333,6 +332,10 @@ def make_parallel_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
                 None, length=epochs_per_call)
             return state, losses.reshape(-1)
 
+    # stable program name -> attributable persistent-cache entries / traces
+    local_fn.__name__ = local_fn.__qualname__ = (
+        f"parallel_epoch_block{epochs_per_call}_{spec.name}_k{spec.k}"
+        if epochs_per_call > 1 else f"parallel_epoch_{spec.name}_k{spec.k}")
     sharded = shard_map(
         local_fn, mesh=mesh,
         in_specs=(P(), P()),

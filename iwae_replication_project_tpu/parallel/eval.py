@@ -29,7 +29,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from iwae_replication_project_tpu.evaluation import activity as au
 from iwae_replication_project_tpu.evaluation.metrics import (
@@ -46,7 +45,7 @@ from iwae_replication_project_tpu.parallel.dp import (
     _fold_axis_coords,
     distributed_logmeanexp,
 )
-from iwae_replication_project_tpu.parallel.mesh import AXES
+from iwae_replication_project_tpu.parallel.mesh import AXES, shard_map
 
 
 def _merge_lse_over_sp(state):

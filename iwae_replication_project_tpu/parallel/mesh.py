@@ -15,11 +15,31 @@ built here:
 from __future__ import annotations
 
 import dataclasses
+import inspect
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+try:  # jax >= 0.6 exports shard_map at top level with `check_vma`
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4/0.5: experimental home, flag named `check_rep`
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_CHECK_FLAG = "check_vma" \
+    if "check_vma" in inspect.signature(_shard_map).parameters else "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable `jax.shard_map` (the repo's one import point).
+
+    The replication/varying-manual-axes checker flag was renamed
+    ``check_rep`` -> ``check_vma`` across JAX releases; callers use the
+    modern spelling and this shim translates for whichever JAX is installed.
+    """
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_CHECK_FLAG: check_vma})
 
 
 @dataclasses.dataclass(frozen=True)

@@ -78,7 +78,11 @@ def make_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, n_train: int,
         state, losses = lax.scan(body, state, (idx, jnp.arange(n_batches)))
         return state._replace(key=key_next), losses
 
+    # stable, descriptive program names: they become the XLA module names, so
+    # persistent-compilation-cache entries (`jit_epoch_IWAE_k50-<hash>`) and
+    # profiler traces are attributable to the objective that compiled them
     if epochs_per_call == 1:
+        epoch.__name__ = epoch.__qualname__ = f"epoch_{spec.name}_k{spec.k}"
         return jax.jit(epoch, donate_argnums=(0,) if donate else ())
 
     def multi(state: TrainState, x_train: jax.Array):
@@ -86,4 +90,6 @@ def make_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, n_train: int,
                                  None, length=epochs_per_call)
         return state, losses.reshape(-1)
 
+    multi.__name__ = multi.__qualname__ = \
+        f"epoch_block{epochs_per_call}_{spec.name}_k{spec.k}"
     return jax.jit(multi, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,314 @@
+"""Warm-path execution engine: persistent XLA cache + AOT executable reuse.
+
+VERDICT r5 measured ~90 s (14%) of the 630 s flagship dress rehearsal going to
+XLA recompiles of programs that never change between stages or restarts. This
+module makes every production entry point compile-once, run-warm:
+
+* :func:`setup_persistent_cache` — one shared switch-on for JAX's persistent
+  compilation cache (``jax_compilation_cache_dir``), keyed under the run's
+  checkpoint directory by default so a preemption-resume pays zero recompiles.
+  Every entry point (experiment driver, bench, dress rehearsal, graft entry)
+  calls this instead of hand-rolling ``jax.config.update`` — a lint-guard test
+  (tests/test_compile_cache.py) enforces it.
+
+* an **AOT executable registry** (:func:`warm_callable`, :func:`aot_call`) —
+  ``.lower().compile()`` runs once per ``(program, static build key, arg
+  shapes/dtypes/shardings)`` signature and the compiled executable is reused
+  across the 8 Burda stages, across ``PASS_BLOCK`` dispatches, and across
+  repeated ``run_experiment`` calls in one process (the driver rebuilds its
+  jitted closures per run; the registry is module-level, so the rebuild is a
+  registry hit instead of a retrace).
+
+* :func:`cache_stats` — hits / misses / compile-seconds accounting, stamped
+  into the per-stage metrics.jsonl rows by the experiment driver. "Misses" of
+  the *persistent* cache are true XLA recompiles: a warm start records zero.
+
+Resolution order for the cache directory: explicit argument (the config
+field) > ``IWAE_COMPILE_CACHE`` env > an already-configured JAX cache dir
+(e.g. tests/conftest.py or ``JAX_COMPILATION_CACHE_DIR``) > ``base_dir/
+.jax_compile_cache``. The values ``off``/``none``/``0`` disable the cache.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Optional, Tuple
+
+#: default cache location relative to the entry point's persistent directory
+#: (the checkpoint dir for the experiment driver — the one directory already
+#: guaranteed to survive a preemption)
+CACHE_SUBDIR = ".jax_compile_cache"
+
+#: spellings of "disabled" accepted from config/env
+_OFF = ("off", "none", "disabled", "0", "")
+
+_lock = threading.Lock()
+_state = {"dir": None, "listeners_installed": False}
+
+#: process-global counters (monotonic; consumers diff snapshots)
+_counters = {
+    # persistent (on-disk) cache: a miss = a real XLA backend compile
+    "persistent_cache_hits": 0,
+    "persistent_cache_misses": 0,
+    # every backend_compile call (incl. ones resolved from the on-disk cache)
+    "backend_compiles": 0,
+    "backend_compile_seconds": 0.0,
+    # AOT registry
+    "aot_hits": 0,
+    "aot_misses": 0,
+    "aot_compile_seconds": 0.0,
+}
+
+#: the AOT executable registry: signature -> jax.stages.Compiled
+_executables: dict = {}
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache
+# ---------------------------------------------------------------------------
+
+def _install_listeners() -> None:
+    """Count persistent-cache hits/misses and backend compile time via JAX's
+    monitoring events. Registered once per process; listener registration has
+    no unregister API, so the counters are process-global and monotonic."""
+    if _state["listeners_installed"]:
+        return
+    try:
+        import jax._src.monitoring as mon
+    except ImportError:  # monitoring moved/private API changed: degrade to
+        _state["listeners_installed"] = True  # aot-only accounting
+        return
+
+    def _on_event(event: str, **kw) -> None:
+        if event == "/jax/compilation_cache/cache_hits":
+            _counters["persistent_cache_hits"] += 1
+        elif event == "/jax/compilation_cache/cache_misses":
+            _counters["persistent_cache_misses"] += 1
+
+    def _on_duration(event: str, duration_secs: float, **kw) -> None:
+        if event == "/jax/core/compile/backend_compile_duration":
+            _counters["backend_compiles"] += 1
+            _counters["backend_compile_seconds"] += duration_secs
+
+    mon.register_event_listener(_on_event)
+    mon.register_event_duration_secs_listener(_on_duration)
+    _state["listeners_installed"] = True
+
+
+def resolve_cache_dir(explicit: Optional[str] = None,
+                      base_dir: Optional[str] = None) -> Optional[str]:
+    """The directory :func:`setup_persistent_cache` would leave active
+    (None = disabled). Shared by setup itself, so the two cannot drift.
+
+    Precedence: `explicit` (the config field) > ``IWAE_COMPILE_CACHE`` env >
+    an already-configured JAX cache dir (kept untouched — first-wins) >
+    ``base_dir/.jax_compile_cache`` > disabled.
+    """
+    path = explicit if explicit is not None \
+        else os.environ.get("IWAE_COMPILE_CACHE")
+    if path is not None:
+        return None if path.strip().lower() in _OFF else path
+    import jax
+    current = getattr(jax.config, "jax_compilation_cache_dir", None) \
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if current:
+        return current
+    if base_dir is not None:
+        return os.path.join(base_dir, CACHE_SUBDIR)
+    return None
+
+
+def mesh_fingerprint(mesh) -> Optional[Tuple]:
+    """Hashable identity of a mesh for registry build keys (axis extents +
+    flat device ids) — the ONE definition both the experiment driver and the
+    facade key the shared executable registry with."""
+    if mesh is None:
+        return None
+    return (tuple(sorted(mesh.shape.items())),
+            tuple(d.id for d in mesh.devices.flat))
+
+
+def setup_persistent_cache(cache_dir: Optional[str] = None, *,
+                           base_dir: Optional[str] = None,
+                           min_compile_secs: float = 0.0) -> Optional[str]:
+    """Enable JAX's persistent compilation cache; returns the active dir.
+
+    `cache_dir` (the config field) and the ``IWAE_COMPILE_CACHE`` env always
+    win (env fills in when the config leaves it None); either set to
+    ``off``/``none``/``0`` disables the cache and returns None. Without an
+    explicit dir, an already-configured JAX cache (conftest, or the
+    ``JAX_COMPILATION_CACHE_DIR`` env JAX reads natively) is kept untouched —
+    first-wins, so a wrapper script that configured the cache is not
+    re-pointed by the driver it launches — and otherwise the cache lands
+    under ``base_dir/.jax_compile_cache``.
+
+    ``min_compile_secs=0.0`` caches *every* program: the warm-start contract
+    is zero recompiles, not zero slow recompiles, and the driver's cheap
+    programs (LR updates, host fetches) are exactly the ones that would
+    otherwise recompile at every stage boundary on a resumed run.
+    """
+    import jax
+
+    with _lock:
+        _install_listeners()
+        path = resolve_cache_dir(cache_dir, base_dir)
+        if path is None:
+            # "off" (or nothing configured anywhere) must actually disable:
+            # clear any cache dir JAX already holds (a wrapper's env, an
+            # earlier setup call), or XLA would keep serving deserialized
+            # executables while cache_stats() claims the cache is off
+            if getattr(jax.config, "jax_compilation_cache_dir", None):
+                jax.config.update("jax_compilation_cache_dir", None)
+            _state["dir"] = None
+            return None
+        current = getattr(jax.config, "jax_compilation_cache_dir", None)
+        if path == current:
+            _state["dir"] = current  # first-wins: keep thresholds untouched
+            return current
+        path = os.path.abspath(os.path.expanduser(path))
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _state["dir"] = path
+        return path
+
+
+def donation_safe() -> bool:
+    """Whether buffer donation may be combined with the active cache setup.
+
+    On the XLA:CPU backend of jaxlib 0.4.x, executables deserialized from the
+    persistent compilation cache mishandle input-output buffer aliasing when
+    the caller donates: the staged driver with donation + a warm cache
+    produces nondeterministic NaN/Inf results and heap corruption
+    (``free(): invalid size`` / segfaults) — reproduced systematically while
+    building this module (donation off OR cache off is stable across every
+    run; donation + warm cache corrupts within a few runs). TPU/GPU
+    executables round-trip donation through their native serialization paths
+    and are unaffected. Until the CPU client is fixed upstream, the driver
+    asks this predicate and quietly drops donation on CPU whenever the
+    persistent cache is active — on CPU there is no HBM pressure for
+    donation to relieve, so the cache is strictly the better half of the
+    trade.
+    """
+    import jax
+
+    if not getattr(jax.config, "jax_compilation_cache_dir", None):
+        return True  # no cache -> nothing deserialized -> donation is fine
+    return jax.default_backend() != "cpu"
+
+
+# ---------------------------------------------------------------------------
+# AOT executable registry
+# ---------------------------------------------------------------------------
+
+def _abstract_signature(args: Tuple) -> Tuple:
+    """Hashable (treedef, per-leaf shape/dtype/sharding) fingerprint of a call.
+
+    Shardings are part of the signature: the same pytree placed under a
+    different mesh (or re-placed single-device) must map to its own
+    executable, not be fed to one compiled for other devices.
+    """
+    import jax
+
+    leaves, treedef = jax.tree.flatten(args)
+    sig = []
+    for leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            sharding = getattr(leaf, "sharding", None)
+            sig.append((tuple(leaf.shape), str(leaf.dtype),
+                        str(sharding) if sharding is not None else ""))
+        else:  # python scalar etc. — weak-typed; key on type + value
+            sig.append((type(leaf).__name__, repr(leaf)))
+    return (str(treedef), tuple(sig))
+
+
+def aot_call(name: str, jitted_fn: Callable, args: Tuple = (),
+             kwargs: Optional[dict] = None,
+             static_kwargs: Optional[dict] = None,
+             build_key: Tuple = ()) -> Any:
+    """Call ``jitted_fn(*args, **kwargs, **static_kwargs)`` via the registry.
+
+    First call per ``(name, build_key, signature(args, kwargs))``:
+    ``jitted_fn.lower(...).compile()`` (a registry *miss*; the lower+compile
+    wall time — which collapses to deserialization on a persistent-cache hit
+    — is accounted as ``aot_compile_seconds``). Every later call reuses the
+    compiled executable (a *hit*) with zero tracing or cache-key hashing of
+    the jaxpr. ``build_key`` must capture everything the caller baked into
+    the closure (objective spec, model config, n_train, donation, mesh, ...):
+    two distinct programs must never share a registry slot.
+
+    Donation declared on `jitted_fn` is preserved by the compiled executable.
+    The executable is invoked with the dynamic arguments only
+    (`static_kwargs` are compile-time constants, already burned into the
+    program — pass statics that interleave positionally by keyword).
+    """
+    kwargs = kwargs or {}
+    key = (name, build_key,
+           _abstract_signature((args, tuple(sorted(kwargs.items(),
+                                                   key=lambda kv: kv[0])))))
+    exe = _executables.get(key)
+    if exe is None:
+        t0 = time.perf_counter()
+        lowered = jitted_fn.lower(*args, **kwargs, **(static_kwargs or {}))
+        exe = lowered.compile()
+        with _lock:
+            _executables[key] = exe
+            _counters["aot_misses"] += 1
+            _counters["aot_compile_seconds"] += time.perf_counter() - t0
+    else:
+        with _lock:
+            _counters["aot_hits"] += 1
+    return exe(*args, **kwargs)
+
+
+def warm_callable(name: str, jitted_fn: Callable,
+                  build_key: Tuple = ()) -> Callable:
+    """Wrap a jitted function so every call routes through :func:`aot_call`.
+
+    Drop-in for the driver's epoch/step functions: same call signature, same
+    results, but the compiled executable is shared process-wide per
+    ``(name, build_key, arg signature)`` — across stages, across
+    ``PASS_BLOCK`` blocks, and across `run_experiment` invocations.
+    """
+    def call(*args):
+        return aot_call(name, jitted_fn, args, build_key=build_key)
+
+    call.__name__ = f"warm_{name}"
+    return call
+
+
+# ---------------------------------------------------------------------------
+# accounting
+# ---------------------------------------------------------------------------
+
+def cache_stats() -> dict:
+    """Snapshot of the process-global warm-path counters.
+
+    ``persistent_cache_misses`` counts true XLA backend compiles whose result
+    was not in the on-disk cache — the number a warm start must hold at zero.
+    ``aot_*`` count the executable-registry behavior; ``backend_compile_
+    seconds`` is total time inside XLA's compile entry point (on a warm start
+    it collapses to cache-deserialization time).
+    """
+    with _lock:
+        snap = dict(_counters)
+    snap["cache_dir"] = _state["dir"]
+    snap["aot_executables"] = len(_executables)
+    return snap
+
+
+def stats_delta(before: dict, after: Optional[dict] = None) -> dict:
+    """Numeric field-wise ``after - before`` of two :func:`cache_stats`
+    snapshots (non-numeric fields are taken from `after`)."""
+    if after is None:
+        after = cache_stats()
+    out = {}
+    for k, v in after.items():
+        b = before.get(k, 0)
+        out[k] = v - b if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) and isinstance(b, (int, float)) else v
+    return out

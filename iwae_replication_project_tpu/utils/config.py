@@ -136,6 +136,24 @@ class ExperimentConfig:
     # None = auto: enabled on TPU when likelihood == "logits".
     fused_likelihood: Optional[bool] = None
 
+    # warm-path execution (utils/compile_cache.py). compile_cache_dir: None =
+    # the default — JAX's persistent compilation cache lands under
+    # `<checkpoint_dir>/.jax_compile_cache`, so a preemption-resume pays zero
+    # recompiles; a path overrides the location; "off" disables. The
+    # IWAE_COMPILE_CACHE env fills in whenever the field is left None.
+    # Execution knob, not a science field (does not change run_name()).
+    compile_cache_dir: Optional[str] = None
+    # donate the train-state buffers to each epoch dispatch (the old state is
+    # dead the moment the new one returns, so XLA may update parameters and
+    # Adam moments in place instead of holding both copies live). Escape
+    # hatch: --no-donate-buffers / donate_buffers=False reproduces the
+    # round-<=5 donate=False driver behavior. Per-leaf bit-identity between
+    # the two modes is pinned by tests/test_compile_cache.py. NOTE the driver
+    # additionally gates this on compile_cache.donation_safe(): jaxlib-0.4.x
+    # XLA:CPU corrupts memory when donated programs are deserialized from the
+    # persistent cache, so on CPU with the cache active donation is dropped.
+    donate_buffers: bool = True
+
     # observability / persistence
     save_figures: bool = True  # per-stage sample/reconstruction PNG grids
     log_dir: str = "runs"
@@ -265,6 +283,15 @@ def build_argparser() -> argparse.ArgumentParser:
                     default=None, type=int,
                     help="also checkpoint every N passes inside a stage "
                          "(0 = stage boundaries only)")
+    ap.add_argument("--compile-cache-dir", dest="compile_cache_dir",
+                    default=None, type=str,
+                    help="persistent XLA compilation cache directory "
+                         "(default: <checkpoint-dir>/.jax_compile_cache; "
+                         "'off' disables; IWAE_COMPILE_CACHE env also honored)")
+    ap.add_argument("--no-donate-buffers", dest="donate_buffers",
+                    action="store_false", default=None,
+                    help="disable train-state buffer donation in the staged "
+                         "driver (the pre-warm-path behavior)")
     ap.add_argument("--no-resume", dest="resume", action="store_false", default=None)
     ap.add_argument("--no-figures", dest="save_figures", action="store_false",
                     default=None)

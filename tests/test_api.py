@@ -188,16 +188,21 @@ class TestJaxSurface:
         (save now writes x.npz, load follows)."""
         path = str(tmp_path / "w.pkl")
         # a stale legacy file at the exact path must not shadow the fresh
-        # save (the old API would have overwritten it)
+        # save — but it is preserved as <stem>.pkl.bak with a warning, not
+        # silently deleted (it may be the only copy of other weights)
         (tmp_path / "w.pkl").write_bytes(b"stale")
-        model.save_weights(path)
+        with pytest.warns(UserWarning, match=r"\.bak"):
+            model.save_weights(path)
         assert (tmp_path / "w.npz").exists()
         assert not (tmp_path / "w.pkl").exists()
+        assert (tmp_path / "w.pkl.bak").read_bytes() == b"stale"
         # ... and the BARE-path save spelling must clear the stale sibling
         # too: otherwise load_weights('w.pkl') would resurrect it
-        (tmp_path / "w.pkl").write_bytes(b"stale")
-        model.save_weights(str(tmp_path / "w"))
+        (tmp_path / "w.pkl").write_bytes(b"stale2")
+        with pytest.warns(UserWarning, match=r"\.bak"):
+            model.save_weights(str(tmp_path / "w"))
         assert not (tmp_path / "w.pkl").exists()
+        assert (tmp_path / "w.pkl.bak").read_bytes() == b"stale2"
         other = build("jax", loss_function="IWAE", k=8, seed=123).compile()
         other.load_weights(path)
         jax.tree.map(lambda a, b: np.testing.assert_array_equal(np.asarray(a),

@@ -142,6 +142,31 @@ class TestKernelParity:
         assert not fl.kernel_usable(8, 4, 16, 12, interpret=False)
         assert len(calls) == 1
 
+    def test_probe_cache_invalidated_by_budget_change(self, monkeypatch):
+        """A mid-process IWAE_FUSED_VMEM_BUDGET change must re-probe, not
+        keep the verdict cached under the old budget: the effective budget is
+        part of the probe-cache key (ADVICE r5)."""
+        from iwae_replication_project_tpu.ops import fused_likelihood as fl
+
+        calls = []
+
+        def fake_probe(*a, **kw):
+            calls.append(a)
+            return True
+
+        monkeypatch.setattr(fl, "_probe_cache", {})
+        monkeypatch.setattr(fl, "_probe_compiles", fake_probe)
+        monkeypatch.setenv("IWAE_FUSED_VMEM_BUDGET", str(1 << 30))
+        assert fl.kernel_usable(8, 4, 16, 12, interpret=False)
+        assert len(calls) == 1
+        # same budget -> cached verdict, no second probe
+        assert fl.kernel_usable(8, 4, 16, 12, interpret=False)
+        assert len(calls) == 1
+        # changed budget -> distinct key -> fresh probe
+        monkeypatch.setenv("IWAE_FUSED_VMEM_BUDGET", str((1 << 30) + 1))
+        assert fl.kernel_usable(8, 4, 16, 12, interpret=False)
+        assert len(calls) == 2
+
     def test_oversized_backward_falls_back_exactly(self):
         """A batch over the backward VMEM budget still differentiates: the
         custom VJP swaps in the XLA backward, whose grads must match the

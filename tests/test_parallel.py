@@ -370,8 +370,8 @@ class TestSampleParallel:
     def test_sp_bound_matches_global_logmeanexp(self, devices, rng):
         """The distributed logmeanexp over a sharded k axis must equal the
         single-device reduction of the gathered weights."""
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+        from iwae_replication_project_tpu.parallel.mesh import shard_map
         from iwae_replication_project_tpu.parallel.dp import distributed_logmeanexp
         from iwae_replication_project_tpu.ops.logsumexp import logmeanexp
 
