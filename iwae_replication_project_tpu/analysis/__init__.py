@@ -1,0 +1,31 @@
+"""Static analysis for the framework's JAX-specific correctness hazards.
+
+``python -m iwae_replication_project_tpu.analysis [paths]`` (or the
+``iwae-lint`` console script) runs every registered rule; see
+``--list-rules``. Library entry points below; rule policy lives in
+``[tool.iwaelint]`` (pyproject.toml); runtime sanitizers (transfer-guard +
+NaN checking around marked tests) live in tests/conftest.py ``--sanitize``.
+"""
+
+from iwae_replication_project_tpu.analysis.config import LintConfig, load_config
+from iwae_replication_project_tpu.analysis.core import (
+    BARE_SUPPRESSION,
+    Finding,
+    Rule,
+    all_rules,
+    lint_file,
+    lint_paths,
+    register,
+)
+
+__all__ = [
+    "BARE_SUPPRESSION",
+    "Finding",
+    "LintConfig",
+    "Rule",
+    "all_rules",
+    "lint_file",
+    "lint_paths",
+    "load_config",
+    "register",
+]

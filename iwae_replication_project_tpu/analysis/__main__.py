@@ -1,0 +1,5 @@
+import sys
+
+from iwae_replication_project_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,138 @@
+"""Lint configuration: defaults + the ``[tool.iwaelint]`` pyproject stanza.
+
+The defaults ARE this repo's production policy (hot-path directories, the
+compile-cache entry points, the shard_map shim location); the pyproject stanza
+exists so the policy is visible and editable next to the pytest/setuptools
+config rather than buried in rule code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Tuple
+
+try:  # py3.11+
+    import tomllib as _toml
+except ImportError:  # py3.10: the vendored backport present in this image
+    try:
+        import tomli as _toml  # type: ignore[no-redef]
+    except ImportError:  # no TOML parser at all: defaults-only operation
+        _toml = None  # type: ignore[assignment]
+
+
+def _default_paths() -> List[str]:
+    return ["iwae_replication_project_tpu", "scripts", "bench.py",
+            "__graft_entry__.py"]
+
+
+def _default_exclude() -> List[str]:
+    return []
+
+
+def _default_hot_paths() -> List[str]:
+    # implicit host syncs are hazards where code runs per-step / per-dispatch
+    return ["iwae_replication_project_tpu/training",
+            "iwae_replication_project_tpu/parallel",
+            "iwae_replication_project_tpu/ops"]
+
+
+def _default_entry_points() -> List[str]:
+    # executable entry points that must enable the persistent compile cache
+    # via the shared helper (utils/compile_cache.setup_persistent_cache) —
+    # migrated from tests/test_compile_cache.py's ad-hoc guard
+    return ["iwae_replication_project_tpu/experiment.py", "bench.py",
+            "scripts/dress_rehearsal.py", "scripts/warm_start_check.py",
+            "__graft_entry__.py"]
+
+
+def _default_cache_owners() -> List[str]:
+    # the only files allowed to touch jax_compilation_cache_dir directly
+    return ["iwae_replication_project_tpu/utils/compile_cache.py"]
+
+
+def _default_import_shims() -> List[str]:
+    # the only files allowed to import version-fragile jax modules directly
+    return ["iwae_replication_project_tpu/parallel/mesh.py"]
+
+
+def _default_fragile_imports() -> List[str]:
+    # modules whose import location / signature moved across jax releases;
+    # PR 1's seed breakage ('from jax import shard_map' on jax 0.4.37, six
+    # test collections down) is the motivating incident
+    return ["jax.experimental.shard_map", "jax.shard_map",
+            "jax.experimental.maps", "jax.experimental.host_callback",
+            "jax.experimental.pjit"]
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Everything rule behavior can be steered by. Field names match the
+    ``[tool.iwaelint]`` TOML keys one-to-one."""
+
+    #: default lint targets when the CLI gets no paths
+    paths: List[str] = dataclasses.field(default_factory=_default_paths)
+    #: substring patterns (root-relative posix) excluded from the walk
+    exclude: List[str] = dataclasses.field(default_factory=_default_exclude)
+    #: run only these rules (empty = all registered)
+    select: List[str] = dataclasses.field(default_factory=list)
+    #: never run these rules
+    disable: List[str] = dataclasses.field(default_factory=list)
+    #: directories where implicit host syncs are flagged (host-sync rule)
+    hot_paths: List[str] = dataclasses.field(default_factory=_default_hot_paths)
+    #: files that must call setup_persistent_cache (cache-setup rule)
+    entry_points: List[str] = dataclasses.field(
+        default_factory=_default_entry_points)
+    #: files allowed to configure jax_compilation_cache_dir directly
+    cache_owners: List[str] = dataclasses.field(
+        default_factory=_default_cache_owners)
+    #: files allowed to import fragile jax modules directly (the shims)
+    import_shims: List[str] = dataclasses.field(
+        default_factory=_default_import_shims)
+    #: fragile module names (fragile-import rule)
+    fragile_imports: List[str] = dataclasses.field(
+        default_factory=_default_fragile_imports)
+    #: repo root all relative paths above resolve against
+    root: Optional[str] = None
+
+
+def find_pyproject(start: str) -> Optional[str]:
+    """Nearest pyproject.toml at or above `start` (a file or directory)."""
+    d = os.path.abspath(start)
+    if os.path.isfile(d):
+        d = os.path.dirname(d)
+    while True:
+        cand = os.path.join(d, "pyproject.toml")
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def load_config(start: Optional[str] = None,
+                pyproject: Optional[str] = None) -> Tuple[LintConfig, Optional[str]]:
+    """Config from the nearest pyproject's ``[tool.iwaelint]`` table merged
+    over the defaults; returns ``(config, pyproject_path_or_None)``. Unknown
+    keys raise — a typo'd policy knob must not silently revert to default.
+    """
+    if pyproject is None:
+        pyproject = find_pyproject(start or os.getcwd())
+    cfg = LintConfig()
+    if pyproject is None or _toml is None:
+        return cfg, None
+    with open(pyproject, "rb") as f:
+        data = _toml.load(f)
+    table = data.get("tool", {}).get("iwaelint", {})
+    known = {f.name for f in dataclasses.fields(LintConfig)}
+    unknown = set(table) - known
+    if unknown:
+        raise ValueError(
+            f"unknown [tool.iwaelint] key(s) in {pyproject}: {sorted(unknown)}"
+            f"; known keys: {sorted(known)}")
+    for key, value in table.items():
+        setattr(cfg, key, value)
+    if cfg.root is None:
+        cfg.root = os.path.dirname(os.path.abspath(pyproject))
+    return cfg, pyproject

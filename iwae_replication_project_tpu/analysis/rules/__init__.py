@@ -1,0 +1,11 @@
+"""Built-in rule modules. Importing this package registers every rule with
+the core registry (``core.all_rules`` triggers the import)."""
+
+from iwae_replication_project_tpu.analysis.rules import (  # noqa: F401
+    dtype,
+    entrypoints,
+    host,
+    imports,
+    jit,
+    prng,
+)

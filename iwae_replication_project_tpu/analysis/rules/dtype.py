@@ -1,0 +1,70 @@
+"""Rule ``dtype-promotion``: float64 / x64 hazards against the production
+numerics.
+
+The framework runs with x64 disabled and ``compute_dtype="bfloat16"`` as the
+licensed production default (RESULTS.md round-5 convergence study). Any
+``float64`` reference is therefore one of two bugs waiting: under default
+config jax silently *downcasts* to f32 (so the annotation lies), and if
+anything flips ``jax_enable_x64`` the promotion rules drag whole expressions
+to f64 — 4x the bytes of bf16 through the MXU-free VPU path. Likewise
+``dtype=float`` means f64 to numpy and "weak f32" to jax: whichever the
+author meant, one reader is wrong.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from iwae_replication_project_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+
+_F64_ATTRS = {"float64", "double", "complex128"}
+
+
+@register
+class DtypePromotionRule(Rule):
+    name = "dtype-promotion"
+    summary = ("float64/x64 dtype reference in production code — the "
+               "framework's numerics are bf16/f32 with x64 disabled")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) and node.attr in _F64_ATTRS:
+                base = Rule.dotted(node.value)
+                if base.split(".")[0] in ("np", "numpy", "jnp", "jax", "onp"):
+                    yield ctx.finding(
+                        self.name, node,
+                        f"'{base}.{node.attr}' under x64-disabled production "
+                        f"numerics: jax silently downcasts it to f32, and "
+                        f"with x64 on it quadruples bf16 memory traffic")
+            elif isinstance(node, ast.Call):
+                name = Rule.call_name(node)
+                if Rule.terminal(name) == "update" and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        node.args[0].value == "jax_enable_x64":
+                    yield ctx.finding(
+                        self.name, node,
+                        "enabling x64 flips global promotion semantics for "
+                        "every module in the process — production code must "
+                        "not toggle it")
+                for kw in node.keywords:
+                    if kw.arg != "dtype":
+                        continue
+                    if isinstance(kw.value, ast.Constant) and \
+                            kw.value.value in ("float64", "double",
+                                               "complex128"):
+                        yield ctx.finding(
+                            self.name, kw.value,
+                            f"dtype={kw.value.value!r} — f64 under "
+                            f"x64-disabled numerics")
+                    elif isinstance(kw.value, ast.Name) and \
+                            kw.value.id == "float":
+                        yield ctx.finding(
+                            self.name, kw.value,
+                            "dtype=float is f64 to numpy but weak-f32 to "
+                            "jax — spell the intended dtype explicitly")

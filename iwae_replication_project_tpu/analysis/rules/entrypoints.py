@@ -1,0 +1,63 @@
+"""Rule ``cache-setup``: warm-path discipline for executable entry points.
+
+Two obligations, both previously enforced by ad-hoc string greps in
+``tests/test_compile_cache.py`` (migrated here so the check has ONE
+implementation and the test suite asserts against the framework):
+
+1. every configured entry point (``[tool.iwaelint] entry_points``) must call
+   ``setup_persistent_cache`` — an entry point that skips it silently re-pays
+   the ~90 s of recompiles the warm-path engine exists to eliminate, and a
+   preemption-resume loses its whole point;
+2. nobody but the owner module(s) (``cache_owners``, default
+   ``utils/compile_cache.py``) may touch ``jax_compilation_cache_dir``
+   directly — split-brain cache config is how the donation-corruption class
+   of RESULTS.md §5 re-enters.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from iwae_replication_project_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+
+
+@register
+class CacheSetupRule(Rule):
+    name = "cache-setup"
+    summary = ("entry point missing setup_persistent_cache(), or "
+               "jax_compilation_cache_dir configured outside "
+               "utils/compile_cache.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        is_owner = ctx.rel_path in ctx.config.cache_owners
+        if ctx.rel_path in ctx.config.entry_points:
+            called = any(
+                isinstance(node, ast.Call) and
+                Rule.terminal(Rule.call_name(node)) == "setup_persistent_cache"
+                for node in ast.walk(ctx.tree))
+            if not called:
+                yield Finding(
+                    path=ctx.rel_path, line=1, col=0, rule=self.name,
+                    message="entry point never calls setup_persistent_cache()"
+                            " — cold starts re-pay every XLA compile (wire it"
+                            " through utils/compile_cache.py)")
+        if is_owner:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    Rule.terminal(Rule.call_name(node)) == "update" and \
+                    node.args and isinstance(node.args[0], ast.Constant) and \
+                    node.args[0].value in ("jax_compilation_cache_dir",
+                                           "jax_persistent_cache_min_compile_time_secs",
+                                           "jax_persistent_cache_min_entry_size_bytes"):
+                yield ctx.finding(
+                    self.name, node,
+                    f"hand-rolled persistent-cache config "
+                    f"('{node.args[0].value}') — utils/compile_cache.py is "
+                    f"the single owner of the cache wiring")

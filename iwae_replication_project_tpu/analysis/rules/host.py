@@ -1,0 +1,75 @@
+"""Rule ``host-sync``: implicit host synchronization in hot-path modules.
+
+``np.asarray`` / ``.item()`` / ``float()`` / ``int()`` on a traced or device
+value forces a device→host transfer and a pipeline flush. In driver code
+that's a deliberate fetch; inside the per-step / per-dispatch modules
+(``training/``, ``parallel/``, ``ops/`` — the config's ``hot_paths``) it
+serializes the async dispatch queue the whole warm-path design leans on
+(experiment.py dispatches whole PASS_BLOCK=27-epoch programs precisely to
+amortize the tunnel). The runtime twin of this rule is the pytest
+``--sanitize`` mode (tests/conftest.py), which runs marked tests under
+``jax.transfer_guard("disallow")``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from iwae_replication_project_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+
+#: numpy-namespace callables that realize device values on host
+_NUMPY_SYNCS = {"asarray", "array"}
+_NUMPY_MODULES = {"np", "numpy", "onp"}
+
+
+@register
+class HostSyncRule(Rule):
+    name = "host-sync"
+    summary = ("implicit device->host sync (np.asarray/.item()/float()/"
+               "jax.device_get) inside a hot-path module")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not any(ctx.rel_path.startswith(hp.rstrip("/") + "/")
+                   or ctx.rel_path == hp for hp in ctx.config.hot_paths):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = Rule.call_name(node)
+            parts = name.split(".")
+            if len(parts) == 2 and parts[0] in _NUMPY_MODULES \
+                    and parts[1] in _NUMPY_SYNCS:
+                yield ctx.finding(
+                    self.name, node,
+                    f"'{name}' in a hot-path module forces a host transfer "
+                    f"and drains the dispatch pipeline — keep data on device "
+                    f"(jnp) or move the fetch to the driver layer")
+            elif name == "jax.device_get":
+                yield ctx.finding(
+                    self.name, node,
+                    "'jax.device_get' in a hot-path module — move the fetch "
+                    "to the driver layer")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                yield ctx.finding(
+                    self.name, node,
+                    "'.item()' blocks on the device and transfers — hot "
+                    "paths must stay async")
+            elif name in ("float", "int", "bool") and node.args and \
+                    isinstance(node.args[0], ast.Call) and \
+                    Rule.call_name(node.args[0]).split(".")[0] in ("jnp",
+                                                                   "jax"):
+                # float(jnp.mean(x)) etc. — scalarizing a device computation
+                # is the implicit-sync shape; float(n)/int(env) on python
+                # values is not, so only jnp/jax call results are flagged
+                yield ctx.finding(
+                    self.name, node,
+                    f"'{name}(...)' on a jax computation blocks and "
+                    f"transfers — keep it a device array (or fetch in the "
+                    f"driver layer)")

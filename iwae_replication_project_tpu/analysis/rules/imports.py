@@ -1,0 +1,62 @@
+"""Rule ``fragile-import``: version-sensitive jax imports outside the shim.
+
+``from jax import shard_map`` worked on one jax release and broke six test
+collections on 0.4.37 (PR 1); the fix was the version-portable shim in
+``parallel/mesh.py`` that translates the ``check_rep``/``check_vma`` rename
+too. This rule makes the shim load-bearing: any direct import of a module on
+the ``fragile_imports`` list outside the configured ``import_shims`` files is
+flagged, so the next version-fragile import can't creep back in.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from iwae_replication_project_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+
+
+@register
+class FragileImportRule(Rule):
+    name = "fragile-import"
+    summary = ("direct import of a version-fragile jax module (e.g. "
+               "shard_map) — route through parallel/mesh.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if ctx.rel_path in ctx.config.import_shims:
+            return
+        fragile = set(ctx.config.fragile_imports)
+        #: `from jax import X` forms covered by dotted entries ("jax.X")
+        from_jax = {m.split(".", 1)[1] for m in fragile
+                    if m.startswith("jax.") and m.count(".") == 1}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    hit = next((m for m in fragile
+                                if alias.name == m
+                                or alias.name.startswith(m + ".")), None)
+                    if hit:
+                        yield ctx.finding(
+                            self.name, node,
+                            f"'import {alias.name}' is version-fragile — "
+                            f"use the shim in parallel/mesh.py")
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.module in fragile or any(
+                        node.module.startswith(m + ".") for m in fragile):
+                    yield ctx.finding(
+                        self.name, node,
+                        f"'from {node.module} import ...' is version-fragile"
+                        f" — use the shim in parallel/mesh.py")
+                elif node.module == "jax":
+                    for alias in node.names:
+                        if alias.name in from_jax:
+                            yield ctx.finding(
+                                self.name, node,
+                                f"'from jax import {alias.name}' moved "
+                                f"across jax releases (broke the seed on "
+                                f"0.4.37) — use the shim in parallel/mesh.py")

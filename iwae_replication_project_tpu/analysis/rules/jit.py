@@ -1,0 +1,314 @@
+"""Compilation-discipline rules: donation, per-call compiles, static args.
+
+* ``donated-after-call`` — a buffer donated to a jitted call is dead the
+  moment the call is issued; reading it afterwards returns whatever the
+  backend left in that memory (RESULTS.md §5 documents the XLA:CPU
+  cache-deserialization variant of this corrupting real runs). JAX only
+  *warns*, and only sometimes.
+* ``jit-in-loop`` — ``jax.jit`` / ``jax.pmap`` / ``.lower().compile()``
+  executed inside a loop builds a fresh program (and usually a fresh trace
+  cache entry) per iteration: the warm-path engine (utils/compile_cache.py)
+  exists precisely so programs are built once and dispatched many times.
+* ``nonhashable-static`` — a list/dict/set passed at a ``static_argnums`` /
+  ``static_argnames`` position raises ``ValueError: Non-hashable static
+  arguments`` only at call time, typically deep inside a driver; the call
+  site is statically visible.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from iwae_replication_project_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pmap", "pmap"}
+
+
+def _literal_int_positions(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """``0`` / ``(0, 2)`` / ``[1]`` -> positions; None when not literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int) \
+                    and not isinstance(elt.value, bool):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _literal_str_names(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _jit_call_info(call: ast.Call) -> Optional[Dict]:
+    """For a ``jax.jit(...)`` (or functools.partial(jax.jit, ...)) call,
+    the donate/static keyword structure; None for other calls."""
+    name = Rule.call_name(call)
+    inner = None
+    if Rule.terminal(name) == "partial" and call.args:
+        inner_name = Rule.dotted(call.args[0])
+        if inner_name in _JIT_NAMES:
+            inner = inner_name
+    if name not in _JIT_NAMES and inner is None:
+        return None
+    info: Dict = {"donate": None, "static_nums": None, "static_names": None}
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            info["donate"] = _literal_int_positions(kw.value) \
+                if kw.arg == "donate_argnums" else ()
+            if info["donate"] is None:
+                info["donate"] = ()  # non-literal: donation exists, pos unknown
+        elif kw.arg == "static_argnums":
+            info["static_nums"] = _literal_int_positions(kw.value)
+        elif kw.arg == "static_argnames":
+            info["static_names"] = _literal_str_names(kw.value)
+    return info
+
+
+_NONHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                ast.SetComp, ast.GeneratorExp)
+
+
+def _scopes(tree: ast.Module) -> Iterator[List[ast.stmt]]:
+    yield tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.body
+
+
+def _walk_scope(body: List[ast.stmt]) -> Iterator[ast.stmt]:
+    """Statements of one scope in source order, descending into compound
+    statements but not into nested function/class scopes."""
+    for stmt in body:
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            yield from _walk_scope(getattr(stmt, field, []) or [])
+        for handler in getattr(stmt, "handlers", []) or []:
+            yield from _walk_scope(handler.body)
+
+
+def _stmt_expr_roots(stmt: ast.stmt) -> List[ast.AST]:
+    """The expression roots a statement evaluates AT its own position —
+    compound statements contribute their headers only (their blocks are
+    yielded separately by :func:`_walk_scope`), nested defs contribute
+    nothing (their bodies are separate scopes)."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return list(stmt.decorator_list)
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, ast.While) or isinstance(stmt, ast.If):
+        return [stmt.test]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, ast.Try):
+        return []
+    return [stmt]
+
+
+def _shallow_walk(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nested scopes (defs, lambdas)."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            continue
+        yield from _shallow_walk(child)
+
+
+def _stmt_nodes(stmt: ast.stmt) -> List[ast.AST]:
+    out: List[ast.AST] = []
+    for root in _stmt_expr_roots(stmt):
+        out.extend(_shallow_walk(root))
+    return out
+
+
+@register
+class DonatedAfterCallRule(Rule):
+    name = "donated-after-call"
+    summary = ("argument donated to a jitted call is read again afterwards — "
+               "its buffer now holds backend garbage")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # pass 1 (module-wide): names bound to donating jitted callables
+        donating: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                info = _jit_call_info(node.value)
+                if info and info["donate"] is not None:
+                    positions = info["donate"] or (0,)
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            donating[tgt.id] = positions
+        if not donating:
+            return
+        # pass 2 (per scope, source order): donate -> dead until re-bound
+        for body in _scopes(ctx.tree):
+            dead: Dict[str, str] = {}  # var -> donating callee name
+            for stmt in _walk_scope(body):
+                nodes = _stmt_nodes(stmt)
+                for call in [n for n in nodes if isinstance(n, ast.Call)]:
+                    fname = Rule.call_name(call)
+                    if fname not in donating:
+                        continue
+                    for pos in donating[fname]:
+                        if pos < len(call.args) and \
+                                isinstance(call.args[pos], ast.Name):
+                            dead[call.args[pos].id] = fname
+                # reads of dead vars (the donating call's own args were
+                # consumed above before the var was marked, same statement)
+                for name_node in [n for n in nodes
+                                  if isinstance(n, ast.Name)
+                                  and isinstance(n.ctx, ast.Load)]:
+                    if name_node.id in dead:
+                        # the donating call itself loads the var legally
+                        if any(isinstance(c, ast.Call)
+                               and Rule.call_name(c) == dead[name_node.id]
+                               and name_node in ast.walk(c)
+                               for c in nodes):
+                            continue
+                        yield ctx.finding(
+                            self.name, name_node,
+                            f"'{name_node.id}' was donated to "
+                            f"'{dead[name_node.id]}' and read again before "
+                            f"re-binding — donated buffers are invalidated "
+                            f"by the call")
+                # re-bindings revive
+                targets: List[ast.AST] = []
+                if isinstance(stmt, ast.Assign):
+                    targets = list(stmt.targets)
+                elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [stmt.target]
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    targets = [stmt.target]
+                for tgt in targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            dead.pop(n.id, None)
+
+
+@register
+class JitInLoopRule(Rule):
+    name = "jit-in-loop"
+    summary = ("jax.jit/pmap or lower().compile() inside a loop — compiles "
+               "per iteration instead of once (route through the AOT "
+               "registry in utils/compile_cache.py)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        yield from self._visit(ctx, ctx.tree, loop_depth=0)
+
+    def _visit(self, ctx: FileContext, node: ast.AST,
+               loop_depth: int) -> Iterator[Finding]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # decorators evaluate at def time — a def inside a loop
+                # re-runs its jit decorators every iteration
+                if loop_depth:
+                    for dec in child.decorator_list:
+                        d = dec.func if isinstance(dec, ast.Call) else dec
+                        if Rule.dotted(d) in _JIT_NAMES or (
+                                isinstance(dec, ast.Call)
+                                and _jit_call_info(dec) is not None):
+                            yield ctx.finding(
+                                self.name, dec,
+                                "jit-decorated def inside a loop re-traces "
+                                "and re-compiles every iteration")
+                # body is a new call-time scope: loop depth resets
+                yield from self._visit(ctx, child, loop_depth=0)
+                continue
+            child_depth = loop_depth + (
+                1 if isinstance(child, (ast.For, ast.AsyncFor, ast.While))
+                else 0)
+            if loop_depth and isinstance(child, ast.Call):
+                name = Rule.call_name(child)
+                if name in _JIT_NAMES:
+                    yield ctx.finding(
+                        self.name, child,
+                        f"'{name}' called inside a loop — the program is "
+                        f"re-built every iteration")
+                elif isinstance(child.func, ast.Attribute) \
+                        and child.func.attr == "compile" \
+                        and isinstance(child.func.value, ast.Call) \
+                        and isinstance(child.func.value.func, ast.Attribute) \
+                        and child.func.value.func.attr == "lower":
+                    yield ctx.finding(
+                        self.name, child,
+                        ".lower().compile() inside a loop — AOT-compile once "
+                        "outside (or use utils/compile_cache.aot_call, which "
+                        "caches by signature)")
+            yield from self._visit(ctx, child, child_depth)
+
+
+@register
+class NonHashableStaticRule(Rule):
+    name = "nonhashable-static"
+    summary = ("list/dict/set passed at a static_argnums/static_argnames "
+               "position of a jitted function — raises at call time")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        jitted: Dict[str, Dict] = {}
+        for node in ast.walk(ctx.tree):
+            info = None
+            fn_name = None
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                info = _jit_call_info(node.value)
+                if info and isinstance(node.targets[0], ast.Name):
+                    fn_name = node.targets[0].id
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        dec_info = _jit_call_info(dec)
+                        if dec_info is not None:
+                            info, fn_name = dec_info, node.name
+            if info is None or fn_name is None:
+                continue
+            if info["static_nums"] or info["static_names"]:
+                jitted[fn_name] = info
+            # non-literal static_argnums is un-analyzable but legal; skip
+        if not jitted:
+            return
+        for call in [n for n in ast.walk(ctx.tree) if isinstance(n, ast.Call)]:
+            fname = Rule.call_name(call)
+            if fname not in jitted:
+                continue
+            info = jitted[fname]
+            for pos in info["static_nums"] or ():
+                if pos < len(call.args) and \
+                        isinstance(call.args[pos], _NONHASHABLE):
+                    yield ctx.finding(
+                        self.name, call.args[pos],
+                        f"non-hashable literal at static position {pos} of "
+                        f"'{fname}' — jit static args must be hashable "
+                        f"(use a tuple / frozen dataclass)")
+            for kw in call.keywords:
+                if kw.arg in (info["static_names"] or ()) and \
+                        isinstance(kw.value, _NONHASHABLE):
+                    yield ctx.finding(
+                        self.name, kw.value,
+                        f"non-hashable literal for static argument "
+                        f"'{kw.arg}' of '{fname}' — jit static args must "
+                        f"be hashable (use a tuple / frozen dataclass)")

@@ -1,0 +1,255 @@
+"""Rule ``key-reuse``: non-linear PRNG key threading.
+
+A JAX PRNG key is a *linear* resource: every consumer must get a fresh key via
+``jax.random.split`` / ``fold_in``. Passing the same key to two samplers does
+not error — it silently makes their draws identical, which for the IWAE bound
+means the K importance samples are correlated and the logmeanexp is a biased
+estimate of nothing in the paper (Burda et al., arXiv:1509.00519 — K
+*independent* samples is the whole point). This is the canonical
+trains-fine-wrong-answer JAX bug, hence a lint rule rather than a code-review
+convention.
+
+Detection (per function scope, statement order, no cross-function dataflow):
+
+* a variable is *key-like* if it is assigned from ``jax.random.PRNGKey`` /
+  ``split`` / ``fold_in`` / ``key`` / ``clone``, or its name looks like a key
+  (``key`` / ``rng`` / ``*_key`` / ``*_rng`` / ``subkey``); arrays of keys
+  (``keys[i]``) are not tracked — subscripted uses are distinct keys;
+* a *consumer* use is the bare variable appearing as a call argument, except
+  in the linearization calls themselves (``split`` / ``fold_in`` — deriving
+  is not consuming) and key plumbing (``PRNGKey``, ``key_data``, ``clone``);
+* two consumer uses with no intervening re-binding of the variable flag the
+  second use. Loop bodies are walked twice (a second iteration re-uses
+  whatever the body did not re-bind); ``if``/``elif``/``else`` branches are
+  walked with forked counters merged by max (branches are alternatives, not
+  sequences). ``try`` bodies/handlers are treated like branches.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Set, Tuple
+
+from iwae_replication_project_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+
+#: name shapes treated as PRNG keys even without a visible jax.random binding
+_KEY_NAME = re.compile(r"^(sub_?key|key|rng|prng_?key)$|(_key|_rng)$")
+
+#: callees that *derive or construct* keys — an argument position here is the
+#: linear-threading idiom itself, not a consumption
+_NON_CONSUMING = {"split", "fold_in", "PRNGKey", "key", "key_data",
+                  "wrap_key_data", "clone", "key_impl"}
+
+
+def _assigned_names(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in target.elts:
+            out.extend(_assigned_names(elt))
+        return out
+    if isinstance(target, ast.Starred):
+        return _assigned_names(target.value)
+    return []
+
+
+def _is_key_rhs(value: ast.AST) -> bool:
+    """Does this assigned value produce PRNG key(s)?"""
+    if isinstance(value, ast.Call):
+        term = Rule.terminal(Rule.call_name(value))
+        return term in ("PRNGKey", "split", "fold_in", "key", "clone")
+    if isinstance(value, (ast.Tuple, ast.List)):
+        return any(_is_key_rhs(v) for v in value.elts)
+    return False
+
+
+class _ScopeLinter:
+    """Statement-ordered walk of one function (or module) body, tracking
+    consumer-use counts per key variable between re-bindings."""
+
+    def __init__(self, ctx: FileContext, rule_name: str):
+        self.ctx = ctx
+        self.rule_name = rule_name
+        self.counts: Dict[str, int] = {}      # uses since last (re)bind
+        self.tracked: Set[str] = set()        # known key-like variables
+        self.untracked: Set[str] = set()      # key-ish NAMES bound to non-keys
+        self.findings: List[Finding] = []
+
+    # -- state forks for branches ------------------------------------------
+
+    def _snapshot(self) -> Tuple[Dict[str, int], Set[str], Set[str]]:
+        return dict(self.counts), set(self.tracked), set(self.untracked)
+
+    def _restore(self, snap: Tuple[Dict[str, int], Set[str], Set[str]]) -> None:
+        self.counts, self.tracked, self.untracked = \
+            dict(snap[0]), set(snap[1]), set(snap[2])
+
+    def _merge_max(self, states: List[Tuple[Dict[str, int], Set[str],
+                                            Set[str]]]) -> None:
+        counts: Dict[str, int] = {}
+        tracked: Set[str] = set()
+        untracked: Set[str] = set()
+        for c, t, u in states:
+            tracked |= t
+            untracked |= u
+            for name, n in c.items():
+                counts[name] = max(counts.get(name, 0), n)
+        self.counts, self.tracked, self.untracked = counts, tracked, untracked
+
+    @staticmethod
+    def _terminates(body: List[ast.stmt]) -> bool:
+        """A branch ending in return/raise/break/continue never falls
+        through — its consumption state must not merge into the after-branch
+        state (``if a: return f(key)`` + a later ``g(key)`` is one consumer
+        per path, not two)."""
+        return bool(body) and isinstance(
+            body[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+    # -- the walk ----------------------------------------------------------
+
+    def run(self, body: List[ast.stmt]) -> List[Finding]:
+        for stmt in body:
+            self._stmt(stmt)
+        return self.findings
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes are linted separately
+        if isinstance(stmt, ast.If):
+            base = self._snapshot()
+            self._block(stmt.body)
+            after_true = base if self._terminates(stmt.body) \
+                else self._snapshot()
+            self._restore(base)
+            self._block(stmt.orelse)
+            after_false = base if self._terminates(stmt.orelse) \
+                else self._snapshot()
+            self._merge_max([after_true, after_false])
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._uses_in(stmt.iter)
+                for n in _assigned_names(stmt.target):
+                    self._bind(n, key_like=_is_key_rhs(stmt.iter))
+            else:
+                self._uses_in(stmt.test)
+            # two passes ≈ two iterations: anything consumed but not re-bound
+            # inside the body trips the reuse counter on the second pass
+            self._block(stmt.body)
+            self._block(stmt.body)
+            self._block(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.Try,)):
+            states = []
+            base = self._snapshot()
+            self._block(stmt.body)
+            self._block(stmt.orelse)
+            states.append(base if self._terminates(stmt.body)
+                          or self._terminates(stmt.orelse)
+                          else self._snapshot())
+            for handler in stmt.handlers:
+                self._restore(base)
+                self._block(handler.body)
+                states.append(base if self._terminates(handler.body)
+                              else self._snapshot())
+            self._merge_max(states)
+            self._block(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._uses_in(item.context_expr)
+                if item.optional_vars is not None:
+                    for n in _assigned_names(item.optional_vars):
+                        self._bind(n, key_like=False)
+            self._block(stmt.body)
+            return
+
+        # simple statement: consumer uses first, then bindings take effect
+        self._uses_in(stmt)
+        if isinstance(stmt, ast.Assign):
+            key_rhs = _is_key_rhs(stmt.value)
+            for target in stmt.targets:
+                for n in _assigned_names(target):
+                    self._bind(n, key_like=key_rhs)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            for n in _assigned_names(stmt.target):
+                self._bind(n, key_like=_is_key_rhs(stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            for n in _assigned_names(stmt.target):
+                self._bind(n, key_like=False)
+
+    def _block(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _bind(self, name: str, key_like: bool) -> None:
+        """A binding is authoritative: assigning a non-key value to a
+        key-looking name (``for key, value in table.items()``) un-tracks it
+        until a key-producing re-bind."""
+        self.counts[name] = 0
+        if key_like:
+            self.tracked.add(name)
+            self.untracked.discard(name)
+        else:
+            self.tracked.discard(name)
+            self.untracked.add(name)
+
+    def _is_tracked(self, name: str) -> bool:
+        if name in self.tracked:
+            return True
+        return name not in self.untracked and bool(_KEY_NAME.search(name))
+
+    def _uses_in(self, node: ast.AST) -> None:
+        """Record consumer uses of tracked keys in all Calls under `node`
+        (skipping nested function/class bodies and lambdas)."""
+        for call in self._calls(node):
+            callee = Rule.call_name(call)
+            if Rule.terminal(callee) in _NON_CONSUMING:
+                continue
+            args = list(call.args) + [kw.value for kw in call.keywords]
+            for arg in args:
+                if isinstance(arg, ast.Name) and self._is_tracked(arg.id):
+                    self._consume(arg.id, arg, callee or "<call>")
+
+    def _calls(self, node: ast.AST) -> Iterator[ast.Call]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            yield from self._calls(child)
+        if isinstance(node, ast.Call):
+            yield node
+
+    def _consume(self, name: str, node: ast.AST, callee: str) -> None:
+        n = self.counts.get(name, 0) + 1
+        self.counts[name] = n
+        if n >= 2:
+            self.findings.append(self.ctx.finding(
+                self.rule_name, node,
+                f"PRNG key '{name}' passed to consumer '{callee}' after an "
+                f"earlier consumer with no intervening jax.random.split/"
+                f"fold_in — reused keys silently correlate samples"))
+
+
+@register
+class KeyReuseRule(Rule):
+    name = "key-reuse"
+    summary = ("PRNG key passed to two consumers (or consumed in a loop) "
+               "without split/fold_in between — draws become identical")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # module scope is a scope too (scripts consume keys at top level)
+        scopes: List[List[ast.stmt]] = [ctx.tree.body]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            yield from _ScopeLinter(ctx, self.name).run(body)

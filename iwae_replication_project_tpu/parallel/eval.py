@@ -341,7 +341,7 @@ def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
     # raises; in single-process runs fetch is equivalent to np.asarray
     from iwae_replication_project_tpu.parallel.multihost import fetch
 
-    scalars = np.asarray(fetch(scalars_fn(params, key, batches)))
+    scalars = np.asarray(fetch(scalars_fn(params, key, batches)))  # iwaelint: disable=host-sync -- end of the fused eval suite: the ONE deliberate fetch that realizes all scalars at once
     acc = {name: float(v) for name, v in zip(SCALAR_NAMES, scalars)}
     # the per-DEVICE chunk actually used (clamped against nll_k/sp inside
     # make_parallel_dataset_scalars) — the eval-RNG version stamp

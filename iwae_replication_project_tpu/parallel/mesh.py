@@ -67,5 +67,5 @@ def make_mesh(dp: Optional[int] = None, sp: int = 1,
         dp = n // sp
     if dp * sp > n:
         raise ValueError(f"mesh {dp}x{sp} needs {dp * sp} devices, have {n}")
-    grid = np.asarray(devs[: dp * sp]).reshape(dp, sp)
+    grid = np.asarray(devs[: dp * sp]).reshape(dp, sp)  # iwaelint: disable=host-sync -- np.asarray of jax.Device OBJECTS (mesh construction), no device buffer is transferred
     return Mesh(grid, (AXES.dp, AXES.sp))

@@ -31,6 +31,7 @@ field) > ``IWAE_COMPILE_CACHE`` env > an already-configured JAX cache dir
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -279,6 +280,29 @@ def warm_callable(name: str, jitted_fn: Callable,
 
     call.__name__ = f"warm_{name}"
     return call
+
+
+@contextlib.contextmanager
+def isolated_aot_registry():
+    """Run with an EMPTY AOT executable registry; restore the previous one
+    (dropping entries created inside) on exit.
+
+    For tests that compare two driver runs: the registry is process-global
+    and keyed by build signature only, so a run inside a test can silently
+    reuse an executable an earlier test compiled under different cache /
+    donation conditions — making the two compared runs asymmetric (one fresh
+    compile, one reuse). Isolation restores the symmetry the comparison
+    assumes.
+    """
+    with _lock:
+        saved = dict(_executables)
+        _executables.clear()
+    try:
+        yield
+    finally:
+        with _lock:
+            _executables.clear()
+            _executables.update(saved)
 
 
 # ---------------------------------------------------------------------------

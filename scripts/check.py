@@ -1,0 +1,67 @@
+"""The single CI gate: static lint, then tier-1 tests (with sanitizers).
+
+``python scripts/check.py`` runs, in order:
+
+1. **iwaelint** over the production tree (``[tool.iwaelint]`` paths) — the
+   8-rule JAX correctness suite (analysis/);
+2. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with ``--sanitize``
+   armed, so the marked subset additionally runs under
+   ``jax.transfer_guard("disallow")`` + ``jax.debug_nans``.
+
+Exit status is nonzero if EITHER stage fails; the lint stage does not
+short-circuit the test stage (CI reports both). ``--lint-only`` /
+``--tests-only`` select a single stage; extra args after ``--`` are passed
+through to pytest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_lint() -> int:
+    print("== iwaelint: static analysis ".ljust(72, "="))
+    return subprocess.call(
+        [sys.executable, "-m", "iwae_replication_project_tpu.analysis"],
+        cwd=REPO)
+
+
+def run_tests(extra) -> int:
+    print("== pytest: tier-1 (fast profile) + sanitizers ".ljust(72, "="))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
+           "--sanitize", "-p", "no:cacheprovider",
+           "--continue-on-collection-errors"] + list(extra)
+    return subprocess.call(cmd, cwd=REPO, env=env)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    passthrough = []
+    if "--" in argv:
+        split = argv.index("--")
+        argv, passthrough = argv[:split], argv[split + 1:]
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--lint-only", action="store_true")
+    ap.add_argument("--tests-only", action="store_true")
+    args = ap.parse_args(argv)
+
+    rc_lint = 0 if args.tests_only else run_lint()
+    rc_tests = 0 if args.lint_only else run_tests(passthrough)
+
+    print("== check summary ".ljust(72, "="))
+    if not args.tests_only:
+        print(f"lint : {'ok' if rc_lint == 0 else f'FAILED (rc={rc_lint})'}")
+    if not args.lint_only:
+        print(f"tests: {'ok' if rc_tests == 0 else f'FAILED (rc={rc_tests})'}")
+    return 1 if (rc_lint or rc_tests) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

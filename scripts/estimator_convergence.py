@@ -1,0 +1,107 @@
+"""Commit the two missing estimator convergence runs (VERDICT r5 weak #2).
+
+STL and PIWAE — the two most algorithmically intricate gradient estimators in
+the repo (objectives/gradients.py: score-stopped graphs, split encoder/decoder
+objectives) — had oracles and mesh tests but zero committed *training* runs:
+per-leaf gradient parity at one point does not show the dynamics are healthy.
+This script trains both to convergence on real data (digits, the offline
+replication protocol of RESULTS.md §2) under the scaled Burda schedule and
+writes the trajectories to ``results/convergence_stl.json`` /
+``results/convergence_piwae.json``. The slow-marked tests in
+tests/test_convergence.py (TestExtendedEstimatorConvergence) re-run a short
+proxy and cross-check these artifacts.
+
+Usage: ``python scripts/estimator_convergence.py [--short]`` (short = the
+3-stage CI proxy instead of the full 8-stage scaled schedule).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RESULTS_DIR = os.path.join(REPO, "results")
+
+#: the two runs: name -> (ExperimentConfig overrides, output file)
+RUNS = {
+    "STL": (dict(loss_function="STL", k=50),
+            "convergence_stl.json"),
+    # PIWAE k1 x k2 = 10 x 5 (the zoo's piwae-10x5 split, digits-scaled)
+    "PIWAE": (dict(loss_function="PIWAE", k=50, k2=5),
+              "convergence_piwae.json"),
+}
+
+
+def run_config(workdir: str, short: bool, **over):
+    from iwae_replication_project_tpu.utils.config import ExperimentConfig
+
+    d = dict(
+        dataset="digits", allow_synthetic=False,
+        n_hidden_encoder=(64,), n_hidden_decoder=(64,),
+        n_latent_encoder=(16,), n_latent_decoder=(784,),
+        batch_size=100, eval_k=5, nll_k=128, nll_chunk=64,
+        eval_batch_size=99, activity_samples=64, save_figures=False,
+        resume=False, seed=0,
+        log_dir=os.path.join(workdir, "runs"),
+        checkpoint_dir=os.path.join(workdir, "ckpt"),
+    )
+    # full protocol: the 8-stage Burda schedule scaled to the 1.5k-image
+    # dataset (passes_scale=0.2, the digits-scaled zoo presets); short: the
+    # 3-stage proxy the CI convergence tests use
+    d.update(dict(n_stages=3) if short
+             else dict(n_stages=8, passes_scale=0.2))
+    d.update(over)
+    return ExperimentConfig(**d)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--short", action="store_true",
+                    help="3-stage CI proxy instead of the full scaled schedule")
+    args = ap.parse_args(argv)
+
+    from iwae_replication_project_tpu.experiment import run_experiment
+
+    os.makedirs(RESULTS_DIR, exist_ok=True)
+    for name, (over, fname) in RUNS.items():
+        with tempfile.TemporaryDirectory() as workdir:
+            cfg = run_config(workdir, args.short, **over)
+            print(f"=== {name}: {cfg.n_stages} stages, k={cfg.k}"
+                  + (f" k2={cfg.k2}" if name == "PIWAE" else ""))
+            _, history = run_experiment(cfg)
+        stages = [{"stage": res["stage"], "NLL": res["NLL"],
+                   "IWAE": res["IWAE"], "VAE": res["VAE"],
+                   "active_units": res2["number_of_active_units"],
+                   "stage_train_seconds": res["stage_train_seconds"]}
+                  for res, res2 in history]
+        nlls = [s["NLL"] for s in stages]
+        out = {
+            "estimator": name,
+            "protocol": ("digits 3-stage CI proxy" if args.short else
+                         "digits scaled Burda schedule (8 stages, "
+                         "passes_scale=0.2), RESULTS.md §2 protocol"),
+            "config": {"k": cfg.k, "k2": cfg.k2, "seed": cfg.seed,
+                       "n_stages": cfg.n_stages,
+                       "passes_scale": cfg.passes_scale,
+                       "arch": "1L h64 z16", "dataset": "digits",
+                       "synthetic_data": bool(history[0][0]["synthetic_data"])},
+            "stages": stages,
+            "final_NLL": nlls[-1],
+            "best_NLL": min(nlls),
+        }
+        path = os.path.join(RESULTS_DIR, fname)
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+        print(f"{name}: final NLL {nlls[-1]:.2f}, best {min(nlls):.2f} "
+              f"-> {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,7 +73,7 @@ def main():
         a = jax.random.normal(keys[0], (100, 100, 100))
         b = jax.random.normal(keys[1], (100, 100, 50))
         return a.sum() + b.sum()
-    print("rng-only equivalent:", time_fn(jax.jit(rng_only), key) * 1e3, "ms")
+    print("rng-only equivalent:", time_fn(jax.jit(rng_only), key) * 1e3, "ms")  # iwaelint: disable=key-reuse -- profiling harness: same key re-used so every timed variant sees identical random draws
 
 
 if __name__ == "__main__":

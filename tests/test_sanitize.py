@@ -1,0 +1,115 @@
+"""Sanitizer-profile tier-1 tests (ISSUE 2 runtime-sanitizer layer).
+
+Run plain, these are ordinary fast tests over the production hot paths. Run
+as ``pytest --sanitize -m sanitize``, conftest wraps each CALL phase in
+``jax.transfer_guard("disallow")`` + ``jax.debug_nans``: the test body must
+perform **zero implicit host<->device transfers** (on jax 0.4.x even
+``x + 1`` eagerly commits the scalar, so the only way to pass is the
+production discipline itself — fully-jitted programs over inputs committed in
+fixtures) and any NaN produced by any primitive raises immediately. This is
+the dynamic twin of the ``host-sync`` lint rule (analysis/rules/host.py): the
+lint rule proves hot-path *modules* contain no implicit-sync calls, this
+profile proves the hot-path *programs* execute without one.
+
+Inputs are committed in module-scope fixtures (setup runs outside the guard —
+minting a key is itself an implicit int32 commit); fetches use np.asarray,
+which the guard treats as explicit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu.evaluation.metrics import (
+    SCALAR_NAMES,
+    dataset_scalars,
+)
+from iwae_replication_project_tpu.models import iwae as model
+from iwae_replication_project_tpu.objectives import ObjectiveSpec
+from iwae_replication_project_tpu.training import create_train_state, make_adam
+from iwae_replication_project_tpu.training.epoch import make_epoch_fn
+from iwae_replication_project_tpu.training.train_step import make_train_step
+
+pytestmark = pytest.mark.sanitize
+
+N, B, D = 96, 32, 784
+
+
+@pytest.fixture(scope="module")
+def dev():
+    """Every host->device commit happens here, in setup, outside the guard:
+    tests receive device-resident state/data/pre-split keys only."""
+    cfg = model.ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                            n_hidden_dec=(16,), n_latent_dec=(D,))
+    spec = ObjectiveSpec("IWAE", k=4)
+    opt = make_adam(eps=1e-4)
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    x = jnp.asarray((np.random.RandomState(0).rand(N, D) > 0.5)
+                    .astype(np.float32))
+    state = create_train_state(keys[0], cfg, optimizer=opt)
+    # pre-shaped views and pre-indexed keys: even an eager x[:B] / keys[1] in
+    # the test body dispatches a slice whose index scalars are implicit commits
+    return {"cfg": cfg, "spec": spec, "opt": opt, "key_eval": keys[1],
+            "x": x, "xb": x[:B], "batches": x.reshape(3, B, D),
+            "state": state}
+
+
+def test_sanitizer_is_armed(request, dev):
+    """Meta-test: with --sanitize the wiring is actually live — an implicit
+    scalar commit raises, and a NaN-producing jitted program raises
+    FloatingPointError instead of silently propagating."""
+    if not request.config.getoption("--sanitize"):
+        pytest.skip("plain profile: sanitizer guards not armed")
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        jnp.ones(())  # implicit host->device commit of the fill scalar
+    # x is in {0,1}; x - 2 < 0, so log produces NaN on every element.
+    # debug_nans detects it and re-runs the program un-jitted to localize;
+    # that eager re-run commits the 2.0 scalar and trips the transfer guard
+    # first on this jax version — either error proves the NaN was caught.
+    with pytest.raises(Exception, match="(?i)nan|disallow"):
+        np.asarray(jax.jit(lambda a: jnp.log(a - 2.0))(dev["x"]))
+
+
+def test_train_step_under_guard(dev):
+    """One jitted train step: dispatch, donate-free, explicit fetch; finite
+    loss and params. debug_nans checks every primitive inside the grad."""
+    step = make_train_step(dev["spec"], dev["cfg"], optimizer=dev["opt"],
+                           donate=False)
+    state, metrics = step(dev["state"], dev["xb"])
+    assert np.isfinite(np.asarray(metrics["loss"]))
+    leaves = jax.tree.leaves(state.params)
+    assert all(np.isfinite(np.asarray(leaf)).all() for leaf in leaves)
+
+
+def test_epoch_scan_under_guard(dev):
+    """The production whole-epoch lax.scan program (the hot path the
+    host-sync lint rule protects) runs start-to-finish with zero implicit
+    transfers; per-batch losses come back finite."""
+    fn = make_epoch_fn(dev["spec"], dev["cfg"], N, B, optimizer=dev["opt"],
+                       donate=False)
+    state, losses = fn(dev["state"], dev["x"])
+    out = np.asarray(losses)
+    assert out.shape == (N // B,)
+    assert np.isfinite(out).all()
+
+
+def test_multi_epoch_block_under_guard(dev):
+    """The PASS_BLOCK-style multi-epoch dispatch (scan over scans) — the
+    program the long Burda stages actually execute."""
+    fn = make_epoch_fn(dev["spec"], dev["cfg"], N, B, optimizer=dev["opt"],
+                       donate=False, epochs_per_call=2)
+    state, losses = fn(dev["state"], dev["x"])
+    out = np.asarray(losses)
+    assert out.shape == (2 * (N // B),)
+    assert np.isfinite(out).all()
+
+
+def test_fused_eval_suite_under_guard(dev):
+    """The one-dispatch fused eval program (all 7 reference scalars): the
+    k=5000-style streaming path in miniature, under transfer guard."""
+    scalars = dataset_scalars(dev["state"].params, dev["cfg"],
+                              dev["key_eval"], dev["batches"], 4, 8, 4)
+    out = np.asarray(scalars)
+    assert out.shape == (len(SCALAR_NAMES),)
+    assert np.isfinite(out).all()
