@@ -41,7 +41,8 @@ def _default_entry_points() -> List[str]:
     # executable entry points that must enable the persistent compile cache
     # via the shared helper (utils/compile_cache.setup_persistent_cache) —
     # migrated from tests/test_compile_cache.py's ad-hoc guard
-    return ["iwae_replication_project_tpu/experiment.py", "bench.py",
+    return ["iwae_replication_project_tpu/experiment.py",
+            "iwae_replication_project_tpu/serving/cli.py", "bench.py",
             "scripts/dress_rehearsal.py", "scripts/warm_start_check.py",
             "__graft_entry__.py"]
 

@@ -186,6 +186,15 @@ class FlexibleModel:
     def _run_name(self) -> str:
         return f"{self.loss_function}-{len(self.n_hidden_encoder)}L-k_{self.k}"
 
+    def serving_engine(self, **knobs):
+        """An online-inference :class:`~.serving.ServingEngine` over this
+        model's current weights (dynamic micro-batching + AOT warm paths —
+        see serving/engine.py). JAX backend only: the eager oracles have no
+        compiled dispatch path to serve from."""
+        raise NotImplementedError(
+            "serving requires backend='jax' (the torch/tf2 oracles have no "
+            "AOT warm path); build the model with backend='jax'")
+
     def tensorboard_log(self, res: dict, epoch_n: int = -1,
                         logdir: str = "runs"):
         """Write the eval scalars (reference schema via tf.summary,

@@ -185,6 +185,16 @@ class JaxFlexibleModel(FlexibleModel):
             self._epoch_sig = sig
         return self._epoch_fn
 
+    def serving_engine(self, **knobs):
+        """Online-inference engine over the CURRENT weights (a snapshot:
+        later train_steps do not retarget an already-built engine). Accepts
+        every ServingEngine knob; `k` defaults to this model's k."""
+        self._require_compiled()
+        from iwae_replication_project_tpu.serving.engine import ServingEngine
+        knobs.setdefault("k", self.k)
+        return ServingEngine(params=self.params, model_config=self.cfg,
+                             **knobs)
+
     # ------------------------------------------------------------------
     # objectives surface (reference get_L_* family)
     # ------------------------------------------------------------------

@@ -1,0 +1,21 @@
+"""Online inference serving: dynamic micro-batching over AOT warm paths.
+
+``ServingEngine`` turns ragged online requests (``encode`` / ``decode`` /
+``score``) into fixed-shape bucket dispatches through the compile-once AOT
+executable registry. See engine.py for the request lifecycle and
+ARCHITECTURE.md "Serving" for the subsystem map. CLI:
+``python -m iwae_replication_project_tpu.serving`` (or ``iwae-serve``).
+"""
+
+from iwae_replication_project_tpu.serving.batcher import (
+    EngineOverloaded,
+    MicroBatcher,
+    Request,
+    RequestTimeout,
+)
+from iwae_replication_project_tpu.serving.buckets import BucketLadder
+from iwae_replication_project_tpu.serving.engine import ServingEngine
+from iwae_replication_project_tpu.serving.metrics import ServingMetrics
+
+__all__ = ["ServingEngine", "BucketLadder", "MicroBatcher", "Request",
+           "ServingMetrics", "EngineOverloaded", "RequestTimeout"]

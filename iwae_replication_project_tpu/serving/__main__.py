@@ -1,0 +1,6 @@
+import sys
+
+from iwae_replication_project_tpu.serving.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,149 @@
+"""Dynamic micro-batcher: queue, coalesce, expire — no device code here.
+
+Pure data-structure layer so every policy decision is unit-testable with an
+injected fake clock (tests/test_serving.py): the engine owns the thread and
+the device dispatch, this module owns WHEN a batch forms.
+
+Policy (per coalescing group — requests only batch with same-program peers,
+i.e. identical ``(op, k)``):
+
+* flush when a group reaches ``max_batch`` requests (full-batch flush), or
+* when the group's oldest request has waited ``max_wait_us`` (latency bound:
+  a lone request is dispatched after at most max_wait_us even at zero load);
+* a request whose deadline passes while queued is completed with a
+  :class:`RequestTimeout` error — never dispatched, never a crash;
+* ``submit`` on a full queue raises :class:`EngineOverloaded` — bounded
+  memory and an explicit shed signal instead of an OOM/latency collapse.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class EngineOverloaded(RuntimeError):
+    """The bounded request queue is full; the caller must back off/retry."""
+
+
+class RequestTimeout(RuntimeError):
+    """The request's deadline passed while it waited in the queue."""
+
+
+@dataclasses.dataclass
+class Request:
+    """One row of work: a single example plus its program selector.
+
+    `seed` versions the request's private RNG stream inside the batched
+    program (serving/programs.py folds it into the engine's base key), so a
+    request's result is a pure function of (weights, payload, seed) — not of
+    whichever batch it happened to be coalesced into.
+    """
+
+    op: str
+    payload: np.ndarray            # [d] one row, engine-validated
+    k: int
+    seed: int
+    t_enqueue: float
+    deadline: Optional[float]      # absolute clock time; None = no timeout
+    future: Future = dataclasses.field(default_factory=Future)
+
+    @property
+    def group(self) -> Tuple[str, int]:
+        return (self.op, self.k)
+
+
+class MicroBatcher:
+    """Bounded multi-group FIFO with max-batch / max-wait flush policy.
+
+    Not thread-safe by itself — the engine serializes access under its own
+    lock. `clock` is injectable (tests drive a fake monotonic clock).
+    """
+
+    def __init__(self, *, max_batch: int, max_wait_us: float,
+                 queue_limit: int,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if queue_limit < 1:
+            raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_us) / 1e6
+        self.queue_limit = int(queue_limit)
+        self.clock = clock
+        self._groups: "OrderedDict[Tuple[str, int], Deque[Request]]" = \
+            OrderedDict()
+        self._pending = 0
+
+    @property
+    def pending(self) -> int:
+        return self._pending
+
+    def submit(self, req: Request) -> None:
+        if self._pending >= self.queue_limit:
+            raise EngineOverloaded(
+                f"request queue full ({self.queue_limit} pending); "
+                f"shedding — retry with backoff")
+        self._groups.setdefault(req.group, deque()).append(req)
+        self._pending += 1
+
+    def poll(self, now: Optional[float] = None, force: bool = False
+             ) -> Tuple[List[Request], List[List[Request]]]:
+        """``(expired, batches)`` ready at time `now`.
+
+        `expired` are requests whose deadline passed while queued (the caller
+        completes them with :class:`RequestTimeout`); each inner list of
+        `batches` is one coalesced dispatch of <= max_batch same-group
+        requests. `force=True` flushes every non-empty group regardless of
+        the wait policy (inline/blocking mode and engine shutdown).
+
+        Expiry pops from each group's HEAD only: deadlines are assumed
+        FIFO-monotone per group (the engine derives them as
+        ``enqueue_time + timeout_s`` under a monotonic clock, so they are).
+        A caller minting out-of-order deadlines degrades gracefully — a
+        mid-queue short-deadline request is served late instead of expired —
+        and in exchange poll() touches O(flushed + expired) requests, not
+        O(pending), per wakeup.
+        """
+        now = self.clock() if now is None else now
+        expired: List[Request] = []
+        batches: List[List[Request]] = []
+        for group in list(self._groups):
+            q = self._groups[group]
+            while q and q[0].deadline is not None and now >= q[0].deadline:
+                expired.append(q.popleft())
+                self._pending -= 1
+            while len(q) >= self.max_batch:
+                batches.append([q.popleft() for _ in range(self.max_batch)])
+                self._pending -= self.max_batch
+            if q and (force or now - q[0].t_enqueue >= self.max_wait_s):
+                batch = list(q)
+                q.clear()
+                self._pending -= len(batch)
+                batches.append(batch)
+            if not q:
+                del self._groups[group]
+        return expired, batches
+
+    def next_event(self, now: Optional[float] = None) -> Optional[float]:
+        """Earliest future clock time at which :meth:`poll` could produce
+        something new (a wait-flush or an expiry), or None when idle. The
+        dispatcher thread sleeps until this instead of busy-polling. Only
+        each group's head matters: FIFO order makes both the wait-flush
+        trigger and (per the monotone-deadline contract above) the earliest
+        expiry a property of ``q[0]``."""
+        now = self.clock() if now is None else now
+        t: Optional[float] = None
+        for q in self._groups.values():
+            if not q:
+                continue
+            cand = q[0].t_enqueue + self.max_wait_s
+            if q[0].deadline is not None:
+                cand = min(cand, q[0].deadline)
+            t = cand if t is None else min(t, cand)
+        return t

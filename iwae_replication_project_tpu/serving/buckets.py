@@ -1,0 +1,81 @@
+"""Shape-bucket ladder: the fixed menu of batch shapes serving may dispatch.
+
+Every distinct batch size is a distinct XLA program, so serving ragged
+request batches at their natural sizes would compile (and registry-key) an
+executable per size seen — a compile storm under live traffic. Instead the
+engine rounds every coalesced batch UP to a small power-of-two ladder
+(1, 2, 4, ..., max_batch): at most ``log2(max_batch)+1`` executables per
+(op, k, dtype) exist, all pre-compiled at warmup, and the padding rows are
+sliced off before results leave the engine (the per-row RNG design in
+serving/programs.py makes real-row values bitwise independent of padding —
+pinned by tests/test_serving.py's parity test).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLadder:
+    """An ascending tuple of permitted batch sizes (the largest is the
+    engine's max coalesced batch)."""
+
+    buckets: Tuple[int, ...]
+
+    def __post_init__(self):
+        if not self.buckets:
+            raise ValueError("ladder needs at least one bucket")
+        if list(self.buckets) != sorted(set(self.buckets)):
+            raise ValueError(f"buckets must be strictly ascending, got "
+                             f"{self.buckets}")
+        if self.buckets[0] < 1:
+            raise ValueError("buckets must be >= 1")
+
+    @staticmethod
+    def powers_of_two(max_batch: int) -> "BucketLadder":
+        """1, 2, 4, ... up to and including `max_batch` (appended as its own
+        rung when it is not itself a power of two)."""
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        rungs = []
+        b = 1
+        while b < max_batch:
+            rungs.append(b)
+            b *= 2
+        rungs.append(max_batch)
+        return BucketLadder(tuple(rungs))
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest rung >= n. Raises for n outside (0, max_batch]."""
+        if n < 1:
+            raise ValueError(f"batch size must be >= 1, got {n}")
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"batch size {n} exceeds the ladder's max bucket "
+                         f"{self.max_batch}")
+
+    def pad_rows(self, rows: np.ndarray, bucket: int) -> np.ndarray:
+        """`rows` ``[n, ...]`` zero-padded to ``[bucket, ...]`` (n <= bucket).
+
+        Zero is a safe fill for every serving op: pixel payloads are {0,1}
+        Bernoulli observations and latent payloads are unconstrained reals,
+        so the padded rows compute ordinary finite garbage that the engine
+        slices off — they can never NaN-poison a dispatch.
+        """
+        n = rows.shape[0]
+        if n > bucket:
+            raise ValueError(f"{n} rows do not fit bucket {bucket}")
+        if n == bucket:
+            return rows
+        out = np.zeros((bucket,) + rows.shape[1:], dtype=rows.dtype)
+        out[:n] = rows
+        return out

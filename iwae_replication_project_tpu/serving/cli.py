@@ -1,0 +1,178 @@
+"""``iwae-serve``: warm the bucket ladder, then serve.
+
+Two modes after warmup:
+
+* **synthetic load** (default): a Poisson-ish open-loop request stream of
+  ragged batch sizes against the engine — the smoke/load profile, printing
+  the metrics snapshot JSON (and stamping it as JSONL through
+  utils/logging.MetricsLogger, same pipeline as the experiment driver);
+* **interactive** (``--interactive``): JSON lines on stdin
+  (``{"op": "score", "x": [[...pixels...]], "k": 50}``), one JSON result
+  line per request on stdout — the request-loop profile.
+
+Weights come from ``--checkpoint RUN_DIR`` (an experiment run directory) or
+are freshly initialized from ``--preset NAME`` / the flagship default —
+untrained, which is fine for load/latency work and makes the CLI runnable in
+a zero-data container.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="iwae-serve",
+        description="online IWAE inference: dynamic micro-batching engine "
+                    "over AOT warm paths")
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--checkpoint", type=str, default=None,
+                     help="experiment checkpoint run directory "
+                          "(<checkpoint_dir>/<run_name>)")
+    src.add_argument("--preset", type=str, default=None,
+                     help="zoo preset naming the architecture (fresh, "
+                          "untrained weights)")
+    ap.add_argument("--k", type=int, default=None,
+                    help="importance samples per score/encode request "
+                         "(default: the preset/checkpoint config's k)")
+    ap.add_argument("--ops", type=str, default="score,encode,decode",
+                    help="comma-separated ops to warm and exercise")
+    ap.add_argument("--max-batch", dest="max_batch", type=int, default=64)
+    ap.add_argument("--max-wait-us", dest="max_wait_us", type=float,
+                    default=2000.0)
+    ap.add_argument("--queue-limit", dest="queue_limit", type=int,
+                    default=1024)
+    ap.add_argument("--timeout-s", dest="timeout_s", type=float, default=2.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--interactive", action="store_true",
+                    help="serve JSON-lines requests from stdin instead of "
+                         "synthetic load")
+    ap.add_argument("--requests", type=int, default=200,
+                    help="synthetic load: number of ragged request batches")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="synthetic load: offered batches/sec (0 = closed "
+                         "loop, as fast as the engine completes)")
+    ap.add_argument("--sizes", type=str, default="1,3,7,17",
+                    help="synthetic load: cycle of ragged batch sizes")
+    ap.add_argument("--log-dir", dest="log_dir", type=str, default=None,
+                    help="also stamp the metrics snapshot as JSONL/TB under "
+                         "this directory (utils/logging.MetricsLogger)")
+    return ap
+
+
+def _build_engine(args):
+    from iwae_replication_project_tpu.serving.engine import ServingEngine
+
+    if args.checkpoint:
+        eng = ServingEngine(args.checkpoint, k=args.k,
+                            max_batch=args.max_batch,
+                            max_wait_us=args.max_wait_us,
+                            queue_limit=args.queue_limit,
+                            timeout_s=args.timeout_s, seed=args.seed)
+        return eng
+    from iwae_replication_project_tpu import zoo
+    from iwae_replication_project_tpu.utils.config import ExperimentConfig
+    ecfg = zoo.get(args.preset) if args.preset else ExperimentConfig()
+    return zoo.serving_engine(
+        ecfg, k=args.k, max_batch=args.max_batch,
+        max_wait_us=args.max_wait_us, queue_limit=args.queue_limit,
+        timeout_s=args.timeout_s, seed=args.seed)
+
+
+def _synthetic_load(eng, ops, args) -> dict:
+    """Open-loop ragged request stream; returns the final snapshot."""
+    import numpy as np
+
+    from iwae_replication_project_tpu.serving.batcher import EngineOverloaded
+
+    sizes = [int(s) for s in args.sizes.split(",") if s]
+    rng = np.random.RandomState(args.seed)
+    dims = eng.row_dims
+    eng.start()
+    futures = []
+    t0 = time.perf_counter()
+    for i in range(args.requests):
+        op = ops[i % len(ops)]
+        n = sizes[i % len(sizes)]
+        batch = (rng.rand(n, dims[op]) > 0.5).astype(np.float32) \
+            if op != "decode" else rng.randn(n, dims[op]).astype(np.float32)
+        for row in batch:
+            try:
+                futures.append(eng.submit(op, row))
+            except EngineOverloaded:
+                pass  # counted by the engine as shed
+        if args.rate > 0:
+            time.sleep(rng.exponential(1.0 / args.rate))
+    for f in futures:
+        try:
+            f.result()
+        except Exception:
+            pass  # timeouts/errors are counted in the snapshot
+    wall = time.perf_counter() - t0
+    eng.stop()
+    snap = eng.metrics.snapshot()
+    snap["wall_seconds"] = round(wall, 3)
+    snap["throughput_rows_per_sec"] = round(
+        snap["counters"]["completed"] / wall, 2) if wall else None
+    return snap
+
+
+def _interactive(eng, args) -> None:
+    eng.start()
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+            op = req.get("op", "score")
+            x = req["x"]
+            fn = {"score": eng.score, "encode": eng.encode,
+                  "decode": eng.decode}[op]
+            kw = {"k": req["k"]} if "k" in req and op != "decode" else {}
+            out = fn(x, **kw)
+            print(json.dumps({"op": op, "result": out.tolist()}), flush=True)
+        except Exception as e:  # a bad request must not kill the loop
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+    eng.stop()
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        setup_persistent_cache)
+
+    # warm path: compiled serving programs persist across server restarts —
+    # keyed under the checkpoint dir when serving one, else the cwd
+    setup_persistent_cache(base_dir=args.checkpoint or os.getcwd())
+
+    eng = _build_engine(args)
+    ops = tuple(s for s in args.ops.split(",") if s)
+    warm = eng.warmup(ops=ops)
+    print(json.dumps({"warmup": warm,
+                      "buckets": list(eng.ladder.buckets),
+                      "k": eng.k}), flush=True)
+
+    if args.interactive:
+        _interactive(eng, args)
+        return 0
+    snap = _synthetic_load(eng, ops, args)
+    print(json.dumps(snap), flush=True)
+    if args.log_dir:
+        from iwae_replication_project_tpu.utils.logging import MetricsLogger
+        logger = MetricsLogger(args.log_dir, run_name="serving")
+        logger.log(eng.metrics.flat(),
+                   step=int(snap["counters"]["dispatches"]))
+        logger.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

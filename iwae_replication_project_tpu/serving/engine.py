@@ -1,0 +1,384 @@
+"""ServingEngine: online inference over the AOT warm paths.
+
+Request lifecycle (ARCHITECTURE.md "Serving"):
+
+    submit -> bounded queue -> coalesce (max_batch / max_wait_us)
+           -> pad to shape bucket -> AOT executable dispatch
+           -> slice real rows -> complete futures
+
+The engine is in-process: callers get ``concurrent.futures.Future``s (or use
+the blocking ``score``/``encode``/``decode`` helpers). A background
+dispatcher thread drives the micro-batcher when :meth:`start` is called;
+without it, the blocking helpers drain the queue inline — fully
+deterministic, which is what the tests use.
+
+Three invariants the design leans on:
+
+* **row independence** — the serving programs (serving/programs.py) key RNG
+  per request, so padded-bucket dispatch is bitwise equal to unpadded
+  execution and padding rows are sliced off, never returned;
+* **closed shape menu** — every dispatch lands on a
+  :class:`~.buckets.BucketLadder` rung, pre-compiled by :meth:`warmup`
+  through the AOT registry (utils/compile_cache.py): a warm engine serves
+  any ragged request stream with zero compiles;
+* **bounded everything** — queue bound (:class:`EngineOverloaded` shed),
+  per-request timeout (:class:`RequestTimeout` error result), dispatch
+  errors land in the affected futures, not in the dispatcher thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from iwae_replication_project_tpu.serving.batcher import (
+    EngineOverloaded,
+    MicroBatcher,
+    Request,
+    RequestTimeout,
+)
+from iwae_replication_project_tpu.serving.buckets import BucketLadder
+from iwae_replication_project_tpu.serving.metrics import ServingMetrics
+from iwae_replication_project_tpu.serving.programs import PROGRAMS
+
+__all__ = ["ServingEngine", "EngineOverloaded", "RequestTimeout"]
+
+
+class ServingEngine:
+    """Typed online-inference API over one model's weights.
+
+    `source` is a compiled jax-backend :class:`~..api.FlexibleModel` or a
+    checkpoint run directory (the ``<checkpoint_dir>/<run_name>`` Orbax tree
+    the experiment driver writes); alternatively pass ``params=`` +
+    ``model_config=`` directly (what the facade's ``serving_engine()`` does).
+
+    Knobs: ``k`` (default importance samples per score/encode request;
+    ``None`` = the checkpoint's stored training k, else 50),
+    ``max_batch``/``max_wait_us`` (coalescing policy), ``queue_limit``
+    (backpressure bound), ``timeout_s`` (per-request queue deadline; None
+    disables), ``ladder`` (shape buckets; default powers-of-two up to
+    max_batch).
+    """
+
+    def __init__(self, source=None, *, params=None, model_config=None,
+                 k: Optional[int] = None, max_batch: int = 64,
+                 max_wait_us: float = 2000.0,
+                 queue_limit: int = 1024, timeout_s: Optional[float] = 2.0,
+                 ladder: Optional[BucketLadder] = None, seed: int = 0,
+                 metrics: Optional[ServingMetrics] = None):
+        import jax
+
+        if isinstance(source, str):
+            params, model_config, stored_k = _load_checkpoint(source)
+            if k is None:
+                k = stored_k  # serve at the budget the model trained under
+        elif source is not None:
+            if getattr(source, "state", None) is None or \
+                    not hasattr(source, "cfg"):
+                raise ValueError(
+                    "source must be a compiled jax-backend FlexibleModel "
+                    "(call .compile() first) or a checkpoint directory path")
+            params, model_config = source.params, source.cfg
+        if params is None or model_config is None:
+            raise ValueError("pass a model, a checkpoint directory, or "
+                             "params= + model_config=")
+        # serving batches are small and vmapped per-row; the Pallas fused
+        # path is shaped for the big eval batches and does not compose with
+        # the row-vmap, so serving programs always run the unfused kernels
+        self.cfg = dataclasses.replace(model_config, fused_likelihood=False)
+        self.k = int(k) if k is not None else 50
+        self.timeout_s = timeout_s
+        self.ladder = ladder or BucketLadder.powers_of_two(max_batch)
+        if self.ladder.max_batch != max_batch:
+            max_batch = self.ladder.max_batch
+        self.metrics = metrics or ServingMetrics()
+        self._clock = time.monotonic
+        self._batcher = MicroBatcher(max_batch=max_batch,
+                                     max_wait_us=max_wait_us,
+                                     queue_limit=queue_limit,
+                                     clock=self._clock)
+        # commit everything device-side ONCE, here: the dispatch path then
+        # only ever device_puts the per-batch payload explicitly, and runs
+        # clean under jax.transfer_guard("disallow") (tests/test_sanitize.py)
+        self._params = jax.device_put(params)
+        self._base_key = jax.device_put(jax.random.PRNGKey(seed))
+        self._seed_counter = 0
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        #: op -> required payload feature count (public: callers building
+        #: requests — e.g. the CLI's load generator — read it from here)
+        self.row_dims = {
+            "score": self.cfg.x_dim,
+            "encode": self.cfg.x_dim,
+            "decode": self.cfg.n_latent_enc[-1],
+        }
+
+    # ------------------------------------------------------------------
+    # request API
+    # ------------------------------------------------------------------
+
+    def submit(self, op: str, row, k: Optional[int] = None) -> Future:
+        """Enqueue ONE example; returns its Future. Raises
+        :class:`EngineOverloaded` when the queue bound is hit.
+
+        The queue only drains when something pumps it: call :meth:`start`
+        first for background dispatch (the serving deployment shape), or
+        follow up with a blocking helper / :meth:`flush` (the inline shape).
+        A bare ``submit(...).result()`` with neither will wait forever —
+        timeouts too are evaluated at pump time, by design (no timer
+        thread)."""
+        if op not in PROGRAMS:
+            raise ValueError(f"unknown op {op!r}; choose {sorted(PROGRAMS)}")
+        _, takes_k = PROGRAMS[op]
+        k = (self.k if k is None else int(k)) if takes_k else 0
+        row = np.asarray(row, np.float32).reshape(-1)
+        want = self.row_dims[op]
+        if row.shape[0] != want:
+            raise ValueError(f"{op} payload must have {want} features, "
+                             f"got {row.shape[0]}")
+        now = self._clock()
+        with self._cv:
+            seed = self._seed_counter
+            self._seed_counter = (self._seed_counter + 1) % (2 ** 31)
+            req = Request(op=op, payload=row, k=k, seed=seed, t_enqueue=now,
+                          deadline=(now + self.timeout_s
+                                    if self.timeout_s is not None else None))
+            try:
+                self._batcher.submit(req)
+            except EngineOverloaded:
+                self.metrics.count("shed")
+                raise
+            self.metrics.count("submitted")
+            self.metrics.set_queue_depth(self._batcher.pending)
+            self._cv.notify()
+        return req.future
+
+    def _blocking(self, op: str, x, k: Optional[int]) -> np.ndarray:
+        x = np.asarray(x, np.float32)
+        single = x.ndim == 1
+        rows = x[None] if single else x.reshape(x.shape[0], -1)
+        futures = [self.submit(op, r, k=k) for r in rows]
+        if self._thread is None:
+            self.flush()
+        out = np.stack([np.asarray(f.result()) for f in futures])
+        return out[0] if single else out
+
+    def score(self, x, k: Optional[int] = None) -> np.ndarray:
+        """k-sample IWAE log p̂(x) per example (``[n]``, or a scalar for a
+        single row). Blocks until served."""
+        return self._blocking("score", x, k)
+
+    def encode(self, x, k: Optional[int] = None) -> np.ndarray:
+        """Posterior deepest-latent mean embedding per example."""
+        return self._blocking("encode", x, k)
+
+    def decode(self, h) -> np.ndarray:
+        """Pixel probabilities decoded from deepest-latent rows."""
+        return self._blocking("decode", h, None)
+
+    # ------------------------------------------------------------------
+    # dispatch machinery
+    # ------------------------------------------------------------------
+
+    def flush(self) -> int:
+        """Drain the queue inline (force-flush every group); returns the
+        number of dispatches. The no-thread mode's engine pump."""
+        n = 0
+        while True:
+            with self._cv:
+                expired, batches = self._batcher.poll(force=True)
+                self.metrics.set_queue_depth(self._batcher.pending)
+            self._complete_expired(expired)
+            if not batches:
+                return n
+            for batch in batches:
+                self._dispatch(batch)
+                n += 1
+
+    def start(self) -> "ServingEngine":
+        """Spawn the background dispatcher thread (idempotent)."""
+        if self._thread is None:
+            self._stop_evt.clear()
+            self._thread = threading.Thread(target=self._loop,
+                                            name="iwae-serve-dispatch",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the dispatcher and drain whatever is still queued."""
+        if self._thread is not None:
+            self._stop_evt.set()
+            with self._cv:
+                self._cv.notify_all()
+            self._thread.join()
+            self._thread = None
+        self.flush()
+
+    def _loop(self) -> None:
+        while not self._stop_evt.is_set():
+            with self._cv:
+                expired, batches = self._batcher.poll()
+                self.metrics.set_queue_depth(self._batcher.pending)
+                if not batches and not expired:
+                    nxt = self._batcher.next_event()
+                    wait = None if nxt is None \
+                        else max(nxt - self._clock(), 1e-4)
+                    self._cv.wait(timeout=wait)
+                    continue
+            self._complete_expired(expired)
+            for batch in batches:
+                self._dispatch(batch)
+
+    @staticmethod
+    def _complete(fut: Future, result=None, exc=None) -> bool:
+        """Complete a future, tolerating caller-side cancellation: a client
+        that cancelled its pending Future must not be able to kill the
+        dispatcher thread with InvalidStateError (the thread outlives any
+        one request by contract). Returns whether the result was delivered."""
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+            return True
+        except Exception:  # cancelled (or already completed): drop quietly
+            return False
+
+    def _complete_expired(self, expired: List[Request]) -> None:
+        for r in expired:
+            self.metrics.count("timeouts")
+            self._complete(r.future, exc=RequestTimeout(
+                f"{r.op} request expired after {self.timeout_s}s in queue "
+                f"(engine saturated — shed load or raise timeout_s)"))
+
+    def _dispatch_args(self, op: str, k: int, payload: np.ndarray,
+                       seeds: np.ndarray) -> Tuple[tuple, dict, dict]:
+        """The (args, kwargs, static_kwargs) of one AOT dispatch — shared by
+        the live path and :meth:`warmup` so both hit the same registry key."""
+        import jax
+
+        program, takes_k = PROGRAMS[op]
+        kwargs = dict(base_key=self._base_key,
+                      seeds=jax.device_put(seeds))
+        if op == "decode":
+            kwargs["h_top"] = jax.device_put(payload)
+        else:
+            kwargs["x"] = jax.device_put(payload)
+        static = dict(cfg=self.cfg)
+        if takes_k:
+            static["k"] = k
+        return (self._params,), kwargs, static
+
+    def _build_key(self, op: str, k: int, bucket: int) -> tuple:
+        return (op, self.cfg, k, bucket)
+
+    def _dispatch(self, batch: List[Request]) -> None:
+        from iwae_replication_project_tpu.utils.compile_cache import (
+            aot_call, cache_stats, stats_delta)
+
+        op, k = batch[0].group
+        n = len(batch)
+        bucket = self.ladder.bucket_for(n)
+        payload = self.ladder.pad_rows(
+            np.stack([r.payload for r in batch]), bucket)
+        seeds = np.zeros((bucket,), np.int32)
+        seeds[:n] = [r.seed for r in batch]
+        program, _ = PROGRAMS[op]
+        args, kwargs, static = self._dispatch_args(op, k, payload, seeds)
+        s0 = cache_stats()
+        try:
+            out = np.asarray(aot_call(f"serve_{op}", program, args,
+                                      kwargs=kwargs, static_kwargs=static,
+                                      build_key=self._build_key(op, k, bucket)))
+        except Exception as e:  # dispatch failure -> per-request error,
+            for r in batch:     # never a dead dispatcher thread
+                self.metrics.count("errors")
+                self._complete(r.future, exc=e)
+            return
+        d = stats_delta(s0)
+        now = self._clock()
+        self.metrics.count("dispatches")
+        self.metrics.count("real_rows", n)
+        self.metrics.count("padded_rows", bucket - n)
+        self.metrics.count("aot_hits", d["aot_hits"])
+        self.metrics.count("aot_misses", d["aot_misses"])
+        self.metrics.count("recompiles", d["persistent_cache_misses"])
+        for i, r in enumerate(batch):
+            self.metrics.record_latency(op, bucket, now - r.t_enqueue)
+            if self._complete(r.future, result=out[i]):
+                self.metrics.count("completed")
+
+    # ------------------------------------------------------------------
+    # warmup
+    # ------------------------------------------------------------------
+
+    def warmup(self, ops: Sequence[str] = ("score", "encode", "decode"),
+               ks: Optional[Iterable[int]] = None) -> Dict[str, float]:
+        """Pre-compile every (op, k, bucket) executable on the ladder via the
+        AOT registry — after this, a ragged request stream over those ops
+        runs with zero compiles (the bench's ``cache_stats`` delta proves
+        it). Returns ``{"programs": N, "compiles": M, "seconds": S}``
+        (programs > compiles when some rungs were already registered)."""
+        from iwae_replication_project_tpu.utils.compile_cache import (
+            aot_warm, cache_stats, stats_delta)
+
+        ks = list(ks) if ks is not None else [self.k]
+        s0 = cache_stats()
+        t0 = time.perf_counter()
+        n_programs = 0
+        for op in ops:
+            if op not in PROGRAMS:
+                raise ValueError(f"unknown op {op!r}")
+            program, takes_k = PROGRAMS[op]
+            for k in (ks if takes_k else [0]):
+                for bucket in self.ladder.buckets:
+                    payload = np.zeros((bucket, self.row_dims[op]),
+                                       np.float32)
+                    seeds = np.zeros((bucket,), np.int32)
+                    args, kwargs, static = self._dispatch_args(
+                        op, k, payload, seeds)
+                    aot_warm(f"serve_{op}", program, args, kwargs=kwargs,
+                             static_kwargs=static,
+                             build_key=self._build_key(op, k, bucket))
+                    n_programs += 1
+        d = stats_delta(s0)
+        return {"programs": float(n_programs),
+                "compiles": float(d["aot_misses"]),
+                "recompiles": float(d["persistent_cache_misses"]),
+                "seconds": round(time.perf_counter() - t0, 3)}
+
+
+def _load_checkpoint(run_dir: str):
+    """(params, ModelConfig, trained k) from an experiment checkpoint run
+    directory, using the stored config JSON for the architecture/template."""
+    import jax
+
+    from iwae_replication_project_tpu.training import (
+        create_train_state, make_adam)
+    from iwae_replication_project_tpu.utils.checkpoint import (
+        restore_latest, stored_config_json)
+    from iwae_replication_project_tpu.utils.config import ExperimentConfig
+
+    cfg_json = stored_config_json(run_dir)
+    if cfg_json is None:
+        raise FileNotFoundError(
+            f"no checkpoint (or no stored config) under {run_dir!r} — pass "
+            f"the run directory the experiment driver writes, "
+            f"<checkpoint_dir>/<run_name>")
+    ecfg = ExperimentConfig.from_json(cfg_json)
+    model_cfg = ecfg.model_config()
+    template = create_train_state(jax.random.PRNGKey(ecfg.seed), model_cfg,
+                                  optimizer=make_adam(eps=ecfg.adam_eps))
+    restored = restore_latest(run_dir, template)
+    if restored is None:
+        raise FileNotFoundError(f"no restorable checkpoint under {run_dir!r}")
+    _, state, _, _ = restored
+    return state.params, model_cfg, ecfg.k

@@ -1,0 +1,134 @@
+"""Serving observability: per-bucket latency histograms + engine counters.
+
+Dependency-free streaming histograms (fixed log-spaced bins, O(1) per
+record) rather than reservoirs: a serving engine must account *every*
+request at heavy load, and p99 from log-spaced bins is within one bin width
+(~33%) of truth at any traffic volume — the right trade for a gauge that
+steers shedding policy.
+
+Two export surfaces, both consistent with utils/logging.py:
+
+* :meth:`ServingMetrics.snapshot` — the nested JSON document (CLI
+  ``--stats``, bench artifacts);
+* :meth:`ServingMetrics.flat` — flat ``str -> float`` rows for
+  ``MetricsLogger`` (JSONL + TensorBoard stamping, same pipeline the
+  experiment driver's per-stage rows ride).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+#: histogram bin geometry: 8 bins per decade from 1 us to 1000 s (+overflow)
+_BINS_PER_DECADE = 8
+_MIN_S = 1e-6
+_DECADES = 9
+_N_BINS = _BINS_PER_DECADE * _DECADES + 1
+
+
+def _bin_index(seconds: float) -> int:
+    if seconds <= _MIN_S:
+        return 0
+    i = int(math.log10(seconds / _MIN_S) * _BINS_PER_DECADE)
+    return min(i, _N_BINS - 1)
+
+
+def _bin_upper(i: int) -> float:
+    return _MIN_S * 10.0 ** ((i + 1) / _BINS_PER_DECADE)
+
+
+class LatencyHistogram:
+    """Log-spaced latency histogram with percentile readout."""
+
+    def __init__(self):
+        self.counts: List[int] = [0] * _N_BINS
+        self.n = 0
+        self.total_s = 0.0
+
+    def record(self, seconds: float) -> None:
+        self.counts[_bin_index(seconds)] += 1
+        self.n += 1
+        self.total_s += seconds
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Upper bound of the bin holding the q-quantile (q in [0, 1])."""
+        if self.n == 0:
+            return None
+        target = q * self.n
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                return _bin_upper(i)
+        return _bin_upper(_N_BINS - 1)
+
+    def summary(self) -> Dict[str, Optional[float]]:
+        mean = self.total_s / self.n if self.n else None
+        return {"count": self.n, "mean_s": mean,
+                "p50_s": self.percentile(0.50),
+                "p95_s": self.percentile(0.95),
+                "p99_s": self.percentile(0.99)}
+
+
+class ServingMetrics:
+    """Thread-safe engine counters + per-(op, bucket) latency histograms."""
+
+    COUNTERS = ("submitted", "completed", "timeouts", "shed", "errors",
+                "dispatches", "real_rows", "padded_rows",
+                "aot_hits", "aot_misses", "recompiles")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c: Dict[str, float] = {k: 0 for k in self.COUNTERS}
+        self._hist: Dict[Tuple[str, int], LatencyHistogram] = {}
+        self.queue_depth = 0          # gauge, engine-maintained
+
+    def count(self, name: str, n: float = 1) -> None:
+        with self._lock:
+            self._c[name] += n
+
+    def set_queue_depth(self, depth: int) -> None:
+        self.queue_depth = int(depth)
+
+    def record_latency(self, op: str, bucket: int, seconds: float) -> None:
+        with self._lock:
+            h = self._hist.get((op, bucket))
+            if h is None:
+                h = self._hist[(op, bucket)] = LatencyHistogram()
+            h.record(seconds)
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The nested JSON document: counters, derived rates, per-bucket
+        latency summaries. Padding waste = fraction of dispatched rows that
+        were filler (the cost of the bucket ladder; high values mean the
+        ladder is too coarse for the observed size mix)."""
+        with self._lock:
+            c = dict(self._c)
+            hists = {f"{op}/b{bucket}": h.summary()
+                     for (op, bucket), h in sorted(self._hist.items())}
+        rows = c["real_rows"] + c["padded_rows"]
+        return {
+            "counters": c,
+            "queue_depth": self.queue_depth,
+            "padding_waste": (c["padded_rows"] / rows) if rows else 0.0,
+            "latency": hists,
+        }
+
+    def flat(self) -> Dict[str, float]:
+        """Flat scalar dict for utils/logging.MetricsLogger (JSONL/TB): one
+        key per counter plus ``latency/<op>/b<bucket>/p{50,95,99}_s``."""
+        snap = self.snapshot()
+        out: Dict[str, float] = {k: float(v)
+                                 for k, v in snap["counters"].items()}
+        out["queue_depth"] = float(snap["queue_depth"])
+        out["padding_waste"] = float(snap["padding_waste"])
+        for name, s in snap["latency"].items():
+            for q in ("p50_s", "p95_s", "p99_s", "mean_s"):
+                if s[q] is not None:
+                    out[f"latency/{name}/{q}"] = float(s[q])
+            out[f"latency/{name}/count"] = float(s["count"])
+        return out

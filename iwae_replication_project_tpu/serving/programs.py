@@ -1,0 +1,78 @@
+"""The serving kernels: batched-yet-row-independent jitted programs.
+
+The training/eval stack keys its RNG per *batch* (one key fans into the
+whole ``[k, B, d]`` sample tensor), so a row's values depend on which batch
+it rides in — fatal for a micro-batching engine that pads ragged request
+batches to shape buckets. These kernels instead ``vmap`` a per-ROW program
+whose key is ``fold_in(base_key, request_seed)``: every row's result is a
+pure function of (params, payload, seed, k), bitwise independent of batch
+size and of the zero-filled padding rows around it. That invariance is what
+lets the engine slice padded results with a straight face — it is pinned by
+tests/test_serving.py::test_padded_bucket_parity.
+
+All three ops share the signature
+``(params, cfg, base_key, seeds[B], payload[B, d], ...)`` with ``cfg`` (and
+``k`` where present) static, so the AOT registry (utils/compile_cache.py)
+keys executables by (op, bucket shape, k, dtype) exactly as the bucket
+ladder intends.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from iwae_replication_project_tpu.models import iwae as model
+from iwae_replication_project_tpu.ops.logsumexp import logmeanexp
+
+
+@partial(jax.jit, static_argnames=("cfg", "k"))
+def score_rows(params, cfg: model.ModelConfig, base_key: jax.Array,
+               seeds: jax.Array, x: jax.Array, k: int) -> jax.Array:
+    """Per-request k-sample IWAE log-likelihood estimate ``[B]``.
+
+    ``log p̂(x_i) = logmeanexp_k(log w)`` — the serving primitive the IWAE
+    bound makes natural (arXiv:1509.00519): tighter monotonically in k, and
+    each request pays exactly its own k importance samples.
+    """
+    def row(seed, xr):
+        lw = model.log_weights(params, cfg, jax.random.fold_in(base_key, seed),
+                               xr[None], k)          # [k, 1]
+        return logmeanexp(lw[:, 0], axis=0)
+    return jax.vmap(row)(seeds, x)
+
+
+@partial(jax.jit, static_argnames=("cfg", "k"))
+def encode_rows(params, cfg: model.ModelConfig, base_key: jax.Array,
+                seeds: jax.Array, x: jax.Array, k: int) -> jax.Array:
+    """Posterior representation per request: the k-sample mean of the deepest
+    latent ``[B, n_latent_enc[-1]]`` (the usable embedding; k averages the
+    sampling noise down without changing the dtype/shape contract)."""
+    def row(seed, xr):
+        h, _, _ = model.encode(params, cfg,
+                               jax.random.fold_in(base_key, seed), xr[None], k)
+        return jnp.mean(h[-1][:, 0, :], axis=0)
+    return jax.vmap(row)(seeds, x)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def decode_rows(params, cfg: model.ModelConfig, base_key: jax.Array,
+                seeds: jax.Array, h_top: jax.Array) -> jax.Array:
+    """Ancestral decode of deepest-latent rows -> pixel probabilities
+    ``[B, x_dim]`` (the sample/reconstruction serving op)."""
+    def row(seed, hr):
+        probs = model.generate_x(params, cfg,
+                                 jax.random.fold_in(base_key, seed),
+                                 hr[None, None, :])  # [1, 1, x_dim]
+        return probs[0, 0]
+    return jax.vmap(row)(seeds, h_top)
+
+
+#: op name -> (jitted program, takes static k?)
+PROGRAMS = {
+    "score": (score_rows, True),
+    "encode": (encode_rows, True),
+    "decode": (decode_rows, False),
+}

@@ -77,6 +77,21 @@ def save_checkpoint(directory: str, step: int, state: TrainState, stage: int,
     mgr.close()
 
 
+def stored_config_json(directory: str) -> Optional[str]:
+    """The experiment-config JSON the newest checkpoint was written under
+    (None when no checkpoint, or none stored). Lets consumers that only have
+    a run directory — e.g. the serving engine's ``ServingEngine(ckpt_dir)``
+    path — rebuild the architecture template before restoring weights."""
+    step = latest_step(directory)
+    if step is None:
+        return None
+    mgr = _manager(directory)
+    meta = mgr.restore(step, args=ocp.args.Composite(
+        meta=ocp.args.JsonRestore()))["meta"]
+    mgr.close()
+    return meta.get("config") or None
+
+
 def latest_step(directory: str) -> Optional[int]:
     if not os.path.isdir(directory):
         return None

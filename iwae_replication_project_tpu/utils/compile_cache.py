@@ -227,6 +227,30 @@ def _abstract_signature(args: Tuple) -> Tuple:
     return (str(treedef), tuple(sig))
 
 
+def _registry_get_or_compile(name: str, jitted_fn: Callable, args: Tuple,
+                             kwargs: dict, static_kwargs: Optional[dict],
+                             build_key: Tuple, count_hit: bool):
+    """Resolve ``(name, build_key, signature)`` to a compiled executable,
+    compiling (and accounting the miss) on first sight. `count_hit=False`
+    lets warmup probes re-resolve without inflating the hit counters."""
+    key = (name, build_key,
+           _abstract_signature((args, tuple(sorted(kwargs.items(),
+                                                   key=lambda kv: kv[0])))))
+    exe = _executables.get(key)
+    if exe is None:
+        t0 = time.perf_counter()
+        lowered = jitted_fn.lower(*args, **kwargs, **(static_kwargs or {}))
+        exe = lowered.compile()
+        with _lock:
+            _executables[key] = exe
+            _counters["aot_misses"] += 1
+            _counters["aot_compile_seconds"] += time.perf_counter() - t0
+    elif count_hit:
+        with _lock:
+            _counters["aot_hits"] += 1
+    return exe
+
+
 def aot_call(name: str, jitted_fn: Callable, args: Tuple = (),
              kwargs: Optional[dict] = None,
              static_kwargs: Optional[dict] = None,
@@ -248,22 +272,26 @@ def aot_call(name: str, jitted_fn: Callable, args: Tuple = (),
     program — pass statics that interleave positionally by keyword).
     """
     kwargs = kwargs or {}
-    key = (name, build_key,
-           _abstract_signature((args, tuple(sorted(kwargs.items(),
-                                                   key=lambda kv: kv[0])))))
-    exe = _executables.get(key)
-    if exe is None:
-        t0 = time.perf_counter()
-        lowered = jitted_fn.lower(*args, **kwargs, **(static_kwargs or {}))
-        exe = lowered.compile()
-        with _lock:
-            _executables[key] = exe
-            _counters["aot_misses"] += 1
-            _counters["aot_compile_seconds"] += time.perf_counter() - t0
-    else:
-        with _lock:
-            _counters["aot_hits"] += 1
+    exe = _registry_get_or_compile(name, jitted_fn, args, kwargs,
+                                   static_kwargs, build_key, count_hit=True)
     return exe(*args, **kwargs)
+
+
+def aot_warm(name: str, jitted_fn: Callable, args: Tuple = (),
+             kwargs: Optional[dict] = None,
+             static_kwargs: Optional[dict] = None,
+             build_key: Tuple = ()) -> Any:
+    """Populate the registry for this call signature WITHOUT executing.
+
+    The bucket-warmup API for online serving (serving/engine.py): an engine
+    pre-compiles one executable per (op, shape bucket, k, dtype) ladder rung
+    at startup, so the first live request of every bucket is already a
+    registry hit — no compile storm under ragged traffic. Returns the
+    executable. A signature already present is a no-op (and is NOT counted
+    as an aot hit: warmup probes must not skew the serving hit-rate metric).
+    """
+    return _registry_get_or_compile(name, jitted_fn, args, kwargs or {},
+                                    static_kwargs, build_key, count_hit=False)
 
 
 def warm_callable(name: str, jitted_fn: Callable,

@@ -116,6 +116,35 @@ def configs() -> Dict[str, ExperimentConfig]:
     return zoo
 
 
+def serving_engine(config_or_name, *, checkpoint_dir: str = None,
+                   k: int = None, **knobs):
+    """A :class:`~.serving.ServingEngine` for a zoo preset (by name or
+    :class:`ExperimentConfig`).
+
+    With `checkpoint_dir` (the experiment run directory), the engine serves
+    the trained weights and the stored config's architecture; without it,
+    weights are freshly initialized from the preset — untrained, which is
+    what load tests and the ``iwae-serve`` synthetic profile want. `k`
+    defaults to the preset's training k (every score/encode request then
+    pays the same importance-sample budget the model was trained under).
+    """
+    from iwae_replication_project_tpu.serving.engine import ServingEngine
+
+    if checkpoint_dir is not None:
+        # k=None -> the stored config's training k (ServingEngine resolves)
+        return ServingEngine(checkpoint_dir, k=k, **knobs)
+    import jax
+
+    from iwae_replication_project_tpu.training import create_train_state
+    cfg = get(config_or_name) if isinstance(config_or_name, str) \
+        else config_or_name
+    state = create_train_state(jax.random.PRNGKey(cfg.seed),
+                               cfg.model_config())
+    return ServingEngine(params=state.params,
+                         model_config=cfg.model_config(),
+                         k=cfg.k if k is None else k, **knobs)
+
+
 def get(name: str) -> ExperimentConfig:
     zoo = configs()
     if name not in zoo:

@@ -3,10 +3,16 @@
 ``python scripts/check.py`` runs, in order:
 
 1. **iwaelint** over the production tree (``[tool.iwaelint]`` paths) — the
-   8-rule JAX correctness suite (analysis/);
+   8-rule JAX correctness suite (analysis/), including the ``cache-setup``
+   guard on every entry point (the ``iwae-serve`` CLI among them);
 2. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with ``--sanitize``
    armed, so the marked subset additionally runs under
-   ``jax.transfer_guard("disallow")`` + ``jax.debug_nans``.
+   ``jax.transfer_guard("disallow")`` + ``jax.debug_nans``. The serving
+   subsystem's fast tests (tests/test_serving.py: batcher policy,
+   padded-bucket parity, shed/timeout robustness, warm-path zero-compile)
+   ride this stage; only the end-to-end synthetic load sweep is ``slow``
+   (run it via ``pytest -m slow tests/test_serving.py`` or
+   ``bench.py --serving``).
 
 Exit status is nonzero if EITHER stage fails; the lint stage does not
 short-circuit the test stage (CI reports both). ``--lint-only`` /

@@ -253,41 +253,64 @@ def test_donated_epoch_bit_identical_per_leaf(rng):
     assert not cc.donation_safe()  # cache restored -> CPU driver drops it
 
 
+_PARITY_CHILD = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+os.environ["IWAE_COMPILE_CACHE"] = "off"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from iwae_replication_project_tpu.experiment import run_experiment
+from iwae_replication_project_tpu.utils import compile_cache as cc
+from iwae_replication_project_tpu.utils.config import ExperimentConfig
+
+tmp = sys.argv[1]
+
+def tiny(tag, donate):
+    # mirrors the parent's _tiny_cfg (n_stages=1)
+    return ExperimentConfig(
+        dataset="binarized_mnist", data_dir=os.path.join(tmp, "data"),
+        n_hidden_encoder=(16,), n_hidden_decoder=(16,),
+        n_latent_encoder=(4,), n_latent_decoder=(784,),
+        loss_function="IWAE", k=4, batch_size=32, n_stages=1,
+        eval_k=4, nll_k=8, nll_chunk=4, eval_batch_size=16,
+        activity_samples=8, save_figures=False,
+        log_dir=os.path.join(tmp, "runs_" + tag),
+        checkpoint_dir=os.path.join(tmp, "ckpt_" + tag),
+        donate_buffers=donate, compile_cache_dir="off")
+
+st_on, hist_on = run_experiment(tiny("don", True), max_batches_per_pass=2,
+                                eval_subset=32)
+assert jax.config.jax_compilation_cache_dir is None  # "off" really off
+assert cc.donation_safe()  # -> the donate run really donated
+st_off, hist_off = run_experiment(tiny("nodon", False),
+                                  max_batches_per_pass=2, eval_subset=32)
+jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+    np.asarray(a), np.asarray(b)), st_on.params, st_off.params)
+assert hist_on[0][0]["NLL"] == hist_off[0][0]["NLL"]
+print("PARITY_OK")
+"""
+
+
 def test_driver_donation_parity(tmp_path):
     """The escape hatch (donate_buffers=False) and the default produce
     identical trained parameters through the full staged driver.
 
-    Runs with the compile cache OFF (compile_cache_dir="off"): with the
-    conftest cache active, donation_safe() would drop donation on CPU and
-    both runs would exercise the identical non-donating path — the donating
-    driver wiring would go untested.
-
-    Runs inside an ISOLATED AOT registry: earlier driver tests registered
-    executables with the same tiny-cfg build keys but compiled under the
-    conftest persistent cache; reusing them makes the two compared runs
-    asymmetric (donate run compiles fresh, no-donate run reuses a
-    deserialized program) and was observed producing spurious full-suite-only
-    parity failures."""
-    from iwae_replication_project_tpu.experiment import run_experiment
-
-    cache_before = jax.config.jax_compilation_cache_dir
-    try:
-        with cc.isolated_aot_registry():
-            st_on, hist_on = run_experiment(
-                _tiny_cfg(tmp_path, "don", n_stages=1, donate_buffers=True,
-                          compile_cache_dir="off"),
-                max_batches_per_pass=2, eval_subset=32)
-            assert jax.config.jax_compilation_cache_dir is None  # "off" off
-            assert cc.donation_safe()  # -> the donate run really donated
-            st_off, hist_off = run_experiment(
-                _tiny_cfg(tmp_path, "nodon", n_stages=1, donate_buffers=False,
-                          compile_cache_dir="off"),
-                max_batches_per_pass=2, eval_subset=32)
-    finally:
-        jax.config.update("jax_compilation_cache_dir", cache_before)
-    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
-        np.asarray(a), np.asarray(b)), st_on.params, st_off.params)
-    assert hist_on[0][0]["NLL"] == hist_off[0][0]["NLL"]
+    Runs in a FRESH SUBPROCESS with the compile cache hard-off: the
+    corruption class this guards against (jaxlib-0.4.x XLA:CPU donation +
+    cache-DESERIALIZED executables, RESULTS.md §5) is heap corruption, so
+    merely isolating the AOT registry in-process is not enough — earlier
+    tests in the same process have already executed cache-deserialized
+    programs, and the donate run was observed to corrupt nondeterministically
+    (~1 in 3 full-file runs) even with its own programs freshly compiled. A
+    fresh process that never touches the persistent cache is the
+    documented-stable configuration, and makes the parity deterministic."""
+    r = subprocess.run([sys.executable, "-c", _PARITY_CHILD, str(tmp_path)],
+                       env=dict(os.environ), cwd=REPO, capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "PARITY_OK" in r.stdout
 
 
 # ---------------------------------------------------------------------------

@@ -113,3 +113,26 @@ def test_fused_eval_suite_under_guard(dev):
     out = np.asarray(scalars)
     assert out.shape == (len(SCALAR_NAMES),)
     assert np.isfinite(out).all()
+
+
+@pytest.fixture(scope="module")
+def serve_eng(dev):
+    """A warmed serving engine (setup outside the guard: construction commits
+    params + base key, warmup compiles the bucket ladder)."""
+    from iwae_replication_project_tpu.serving import ServingEngine
+
+    eng = ServingEngine(params=dev["state"].params, model_config=dev["cfg"],
+                        k=4, max_batch=4, timeout_s=None)
+    eng.warmup(ops=("score",))
+    return {"eng": eng, "rows": np.asarray(dev["xb"][:3])}
+
+
+def test_serving_dispatch_under_guard(serve_eng):
+    """The engine's public dispatch path — queue -> coalesce -> pad-to-bucket
+    -> AOT dispatch -> slice — on the warm path: every transfer it performs
+    is explicit (device_put for payloads/seeds, np.asarray for results), so
+    a warm serve round runs clean under transfer_guard('disallow'), and
+    debug_nans certifies the per-row score program NaN-free."""
+    out = serve_eng["eng"].score(serve_eng["rows"])
+    assert out.shape == (3,)
+    assert np.isfinite(out).all()

@@ -1,0 +1,440 @@
+"""Serving subsystem tests: ladder, batcher policy (fake clock), padded-bucket
+parity, robustness (timeout / backpressure), warm-path zero-compile, and the
+checkpoint -> engine path. The synthetic load sweep lives in the slow profile.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu.models import iwae as model
+from iwae_replication_project_tpu.serving import (
+    BucketLadder,
+    EngineOverloaded,
+    MicroBatcher,
+    Request,
+    RequestTimeout,
+    ServingEngine,
+)
+from iwae_replication_project_tpu.serving import programs
+
+D = 32
+TINY = dict(n_hidden_enc=(16, 8), n_latent_enc=(8, 4),
+            n_hidden_dec=(8, 16), n_latent_dec=(8, D))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = model.ModelConfig(x_dim=D, **TINY)
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+    x = (np.random.RandomState(0).rand(17, D) > 0.5).astype(np.float32)
+    return {"cfg": cfg, "params": params, "x": x}
+
+
+def make_engine(tiny, **kw):
+    kw.setdefault("k", 4)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("timeout_s", 30.0)
+    return ServingEngine(params=tiny["params"], model_config=tiny["cfg"], **kw)
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder
+# ---------------------------------------------------------------------------
+
+def test_ladder_powers_of_two():
+    lad = BucketLadder.powers_of_two(64)
+    assert lad.buckets == (1, 2, 4, 8, 16, 32, 64)
+    assert lad.bucket_for(1) == 1
+    assert lad.bucket_for(3) == 4
+    assert lad.bucket_for(64) == 64
+    # non-power-of-two max becomes its own top rung
+    assert BucketLadder.powers_of_two(48).buckets == (1, 2, 4, 8, 16, 32, 48)
+    with pytest.raises(ValueError):
+        lad.bucket_for(65)
+    with pytest.raises(ValueError):
+        lad.bucket_for(0)
+    with pytest.raises(ValueError):
+        BucketLadder((4, 2))
+
+
+def test_ladder_pad_rows():
+    lad = BucketLadder.powers_of_two(8)
+    rows = np.ones((3, 5), np.float32)
+    padded = lad.pad_rows(rows, 4)
+    assert padded.shape == (4, 5)
+    assert np.array_equal(padded[:3], rows) and np.all(padded[3] == 0)
+    assert lad.pad_rows(rows, 3) is rows  # exact fit: no copy
+    with pytest.raises(ValueError):
+        lad.pad_rows(rows, 2)
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher policy under a fake clock
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _req(op="score", k=4, seed=0, t=100.0, deadline=None):
+    return Request(op=op, payload=np.zeros(D, np.float32), k=k, seed=seed,
+                   t_enqueue=t, deadline=deadline)
+
+
+def test_batcher_max_batch_flush():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=4, max_wait_us=10_000, queue_limit=64,
+                     clock=clk)
+    for i in range(9):
+        b.submit(_req(seed=i, t=clk.t))
+    expired, batches = b.poll()  # no time has passed: only full batches go
+    assert expired == []
+    assert [len(x) for x in batches] == [4, 4]
+    assert b.pending == 1
+    assert [r.seed for r in batches[0]] == [0, 1, 2, 3]  # FIFO preserved
+
+
+def test_batcher_max_wait_flush():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=8, max_wait_us=2_000, queue_limit=64,
+                     clock=clk)
+    b.submit(_req(seed=0, t=clk.t))
+    assert b.poll() == ([], [])          # policy not met yet
+    assert b.next_event() == pytest.approx(100.0 + 0.002)
+    clk.t += 0.0025                       # > max_wait: lone request flushes
+    expired, batches = b.poll()
+    assert expired == [] and [len(x) for x in batches] == [1]
+    assert b.pending == 0
+
+
+def test_batcher_groups_do_not_mix():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=8, max_wait_us=0, queue_limit=64, clock=clk)
+    b.submit(_req(k=4, seed=0, t=clk.t))
+    b.submit(_req(k=8, seed=1, t=clk.t))
+    b.submit(_req(op="encode", k=4, seed=2, t=clk.t))
+    _, batches = b.poll()
+    assert sorted((x[0].group, len(x)) for x in batches) == [
+        (("encode", 4), 1), (("score", 4), 1), (("score", 8), 1)]
+
+
+def test_batcher_timeout_expiry():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=4, max_wait_us=1_000_000, queue_limit=64,
+                     clock=clk)
+    b.submit(_req(seed=0, t=clk.t, deadline=clk.t + 0.5))
+    b.submit(_req(seed=1, t=clk.t, deadline=clk.t + 5.0))
+    clk.t += 1.0
+    expired, batches = b.poll()
+    assert [r.seed for r in expired] == [0]
+    assert [len(x) for x in batches] == [1]  # survivor flushes via max-wait
+    assert b.pending == 0
+
+
+def test_batcher_backpressure_bound():
+    b = MicroBatcher(max_batch=4, max_wait_us=0, queue_limit=2,
+                     clock=FakeClock())
+    b.submit(_req(seed=0))
+    b.submit(_req(seed=1))
+    with pytest.raises(EngineOverloaded):
+        b.submit(_req(seed=2))
+    assert b.pending == 2
+
+
+def test_batcher_force_flush():
+    clk = FakeClock()
+    b = MicroBatcher(max_batch=8, max_wait_us=10_000_000, queue_limit=64,
+                     clock=clk)
+    for i in range(3):
+        b.submit(_req(seed=i, t=clk.t))
+    assert b.poll() == ([], [])
+    _, batches = b.poll(force=True)
+    assert [len(x) for x in batches] == [3]
+
+
+# ---------------------------------------------------------------------------
+# padded-bucket parity: the engine's results ARE the model's results
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 3, 7, 17])
+def test_padded_bucket_parity_score(tiny, n):
+    """Engine score over a ragged batch == direct unpadded program call,
+    bitwise (same dtype, same seeds): padding rows never leak."""
+    eng = make_engine(tiny, max_batch=32)
+    x = tiny["x"][:n]
+    got = eng.score(x)
+    direct = np.asarray(programs.score_rows(
+        tiny["params"], eng.cfg, eng._base_key,
+        jnp.arange(n, dtype=jnp.int32), jnp.asarray(x), 4))
+    assert got.dtype == direct.dtype
+    assert np.array_equal(got, direct)
+
+
+@pytest.mark.parametrize("n", [1, 3, 7, 17])
+def test_padded_bucket_parity_encode(tiny, n):
+    eng = make_engine(tiny, max_batch=32)
+    x = tiny["x"][:n]
+    got = eng.encode(x)
+    direct = np.asarray(programs.encode_rows(
+        tiny["params"], eng.cfg, eng._base_key,
+        jnp.arange(n, dtype=jnp.int32), jnp.asarray(x), 4))
+    assert got.dtype == direct.dtype
+    assert np.array_equal(got, direct)
+
+
+def test_padded_bucket_parity_decode(tiny):
+    eng = make_engine(tiny, max_batch=8)
+    h = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+    got = eng.decode(h)
+    direct = np.asarray(programs.decode_rows(
+        tiny["params"], eng.cfg, eng._base_key,
+        jnp.arange(3, dtype=jnp.int32), jnp.asarray(h)))
+    assert np.array_equal(got, direct)
+    assert got.shape == (3, D) and got.min() > 0 and got.max() < 1
+
+
+def test_single_row_request(tiny):
+    eng = make_engine(tiny)
+    s = eng.score(tiny["x"][0])
+    assert s.shape == () and np.isfinite(s)
+    e = eng.encode(tiny["x"][0])
+    assert e.shape == (4,)
+
+
+# ---------------------------------------------------------------------------
+# robustness: timeout, backpressure, dispatch errors
+# ---------------------------------------------------------------------------
+
+def test_engine_timeout_is_per_request_error(tiny):
+    eng = make_engine(tiny, timeout_s=0.0)  # every request expires on poll
+    fut = eng.submit("score", tiny["x"][0])
+    eng.flush()
+    with pytest.raises(RequestTimeout):
+        fut.result(timeout=5)
+    assert eng.metrics.snapshot()["counters"]["timeouts"] == 1
+    # the engine survives and keeps serving once the deadline allows
+    eng.timeout_s = None
+    assert np.isfinite(eng.score(tiny["x"][0]))
+
+
+def test_engine_backpressure_sheds(tiny):
+    eng = make_engine(tiny, queue_limit=2)
+    eng.submit("score", tiny["x"][0])
+    eng.submit("score", tiny["x"][1])
+    with pytest.raises(EngineOverloaded):
+        eng.submit("score", tiny["x"][2])
+    assert eng.metrics.snapshot()["counters"]["shed"] == 1
+    eng.flush()  # queued work still completes
+
+
+def test_cancelled_future_does_not_kill_dispatch(tiny):
+    """A client cancelling its pending Future must not blow up the dispatch
+    path (InvalidStateError on completion) — remaining requests in the batch
+    still complete, and the cancelled one is not counted as completed."""
+    eng = make_engine(tiny)
+    f1 = eng.submit("score", tiny["x"][0])
+    assert f1.cancel()
+    f2 = eng.submit("score", tiny["x"][1])
+    eng.flush()
+    assert np.isfinite(np.asarray(f2.result(timeout=60)))
+    assert f1.cancelled()
+    c = eng.metrics.snapshot()["counters"]
+    assert c["completed"] == 1 and c["errors"] == 0
+
+
+def test_engine_rejects_bad_requests(tiny):
+    eng = make_engine(tiny)
+    with pytest.raises(ValueError, match="unknown op"):
+        eng.submit("frobnicate", tiny["x"][0])
+    with pytest.raises(ValueError, match="features"):
+        eng.submit("score", np.zeros(7, np.float32))
+
+
+def test_background_thread_round_trip(tiny):
+    eng = make_engine(tiny, max_wait_us=500.0)
+    eng.start()
+    try:
+        futs = [eng.submit("score", r) for r in tiny["x"][:5]]
+        out = np.array([f.result(timeout=60) for f in futs])
+    finally:
+        eng.stop()
+    direct = eng.score(tiny["x"][:5])  # inline path, fresh seeds
+    assert out.shape == (5,) and np.isfinite(out).all()
+    # same rows, different request seeds -> close but not identical streams
+    assert np.all(np.abs(out - direct) < 10.0)
+
+
+# ---------------------------------------------------------------------------
+# warm path: zero compiles across a ragged stream after warmup
+# ---------------------------------------------------------------------------
+
+def test_warmup_then_zero_compiles(tiny):
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        cache_stats, stats_delta)
+
+    eng = make_engine(tiny, max_batch=8)
+    warm = eng.warmup(ops=("score",))
+    assert warm["programs"] == len(eng.ladder.buckets)
+    s0 = cache_stats()
+    for n in (1, 3, 7, 2, 8, 5, 1, 4):
+        eng.score(tiny["x"][:n])
+    d = stats_delta(s0)
+    assert d["aot_misses"] == 0, "ragged stream compiled after warmup"
+    c = eng.metrics.snapshot()["counters"]
+    assert c["aot_misses"] == 0 and c["recompiles"] == 0
+    assert c["aot_hits"] == 8
+
+
+def test_metrics_accounting(tiny):
+    eng = make_engine(tiny, max_batch=8)
+    eng.score(tiny["x"][:3])  # bucket 4: one padding row
+    snap = eng.metrics.snapshot()
+    c = snap["counters"]
+    assert c["submitted"] == c["completed"] == 3
+    assert c["dispatches"] == 1
+    assert c["real_rows"] == 3 and c["padded_rows"] == 1
+    assert snap["padding_waste"] == pytest.approx(0.25)
+    lat = snap["latency"]["score/b4"]
+    assert lat["count"] == 3
+    assert lat["p50_s"] is not None and lat["p99_s"] >= lat["p50_s"]
+    flat = eng.metrics.flat()
+    assert flat["latency/score/b4/count"] == 3.0
+    assert all(isinstance(v, float) for v in flat.values())
+
+
+def test_latency_histogram_percentiles():
+    from iwae_replication_project_tpu.serving.metrics import LatencyHistogram
+
+    h = LatencyHistogram()
+    assert h.percentile(0.5) is None
+    for ms in range(1, 101):  # 1..100 ms uniform
+        h.record(ms / 1000.0)
+    # log-bin upper bounds: within one bin (~33%) of the true quantile
+    assert 0.04 < h.percentile(0.50) < 0.09
+    assert 0.08 < h.percentile(0.99) < 0.17
+    assert h.summary()["count"] == 100
+
+
+# ---------------------------------------------------------------------------
+# construction paths: facade, checkpoint, zoo
+# ---------------------------------------------------------------------------
+
+def test_facade_serving_engine(tiny):
+    from iwae_replication_project_tpu.api import FlexibleModel
+
+    mdl = FlexibleModel([16, 8], [8, 16], [8, 4], [8, D],
+                        dataset_bias=None, loss_function="IWAE", k=4,
+                        backend="jax").compile()
+    eng = mdl.serving_engine(max_batch=4)
+    assert eng.k == 4
+    out = eng.score((np.random.RandomState(2).rand(2, D) > 0.5)
+                    .astype(np.float32))
+    assert out.shape == (2,) and np.isfinite(out).all()
+
+
+def test_eager_backend_has_no_serving():
+    from iwae_replication_project_tpu.api import FlexibleModel
+
+    torch = pytest.importorskip("torch")  # noqa: F841
+    mdl = FlexibleModel([16], [16], [4], [D], dataset_bias=None,
+                        backend="torch")
+    with pytest.raises(NotImplementedError, match="backend='jax'"):
+        mdl.serving_engine()
+
+
+def test_engine_requires_a_source(tiny):
+    with pytest.raises(ValueError, match="checkpoint directory"):
+        ServingEngine()
+    with pytest.raises(ValueError, match="compile"):
+        ServingEngine(object())
+
+
+def test_engine_from_checkpoint(tmp_path):
+    """The ServingEngine(checkpoint_dir) path: restore the stored config +
+    weights and serve bitwise-identically to an engine built from the same
+    params directly."""
+    from iwae_replication_project_tpu.training import (
+        create_train_state, make_adam)
+    from iwae_replication_project_tpu.utils.checkpoint import save_checkpoint
+    from iwae_replication_project_tpu.utils.config import ExperimentConfig
+
+    ecfg = ExperimentConfig(n_hidden_encoder=(8,), n_latent_encoder=(4,),
+                            n_hidden_decoder=(8,), n_latent_decoder=(784,),
+                            k=7, compute_dtype=None, fused_likelihood=False)
+    state = create_train_state(jax.random.PRNGKey(3), ecfg.model_config(),
+                               optimizer=make_adam(eps=ecfg.adam_eps))
+    run_dir = str(tmp_path / "run")
+    save_checkpoint(run_dir, 0, state, stage=1, config_json=ecfg.to_json())
+
+    # k unspecified -> the stored config's training k, not a hardcoded 50
+    assert ServingEngine(run_dir, max_batch=1).k == 7
+
+    eng = ServingEngine(run_dir, k=3, max_batch=4)
+    x = (np.random.RandomState(4).rand(2, 784) > 0.5).astype(np.float32)
+    got = eng.score(x)
+    ref = ServingEngine(params=state.params,
+                        model_config=ecfg.model_config(), k=3,
+                        max_batch=4).score(x)
+    assert np.array_equal(got, ref)
+
+    with pytest.raises(FileNotFoundError):
+        ServingEngine(str(tmp_path / "nope"))
+
+
+def test_zoo_serving_engine():
+    from iwae_replication_project_tpu import zoo
+    from iwae_replication_project_tpu.utils.config import ExperimentConfig
+
+    ecfg = ExperimentConfig(n_hidden_encoder=(8,), n_latent_encoder=(4,),
+                            n_hidden_decoder=(8,), n_latent_decoder=(784,),
+                            k=2, compute_dtype=None, fused_likelihood=False)
+    eng = zoo.serving_engine(ecfg, max_batch=2)
+    assert eng.k == 2
+    x = (np.random.RandomState(5).rand(1, 784) > 0.5).astype(np.float32)
+    assert np.isfinite(eng.score(x)).all()
+
+
+# ---------------------------------------------------------------------------
+# the synthetic load sweep (slow profile)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_cli_synthetic_load_sweep(tmp_path):
+    """End-to-end ``iwae-serve`` synthetic load: warmup line then a snapshot
+    with zero recompiles across the ragged stream and sane latency fields."""
+    r = subprocess.run(
+        [sys.executable, "-m", "iwae_replication_project_tpu.serving",
+         "--preset", "digits-vae-1l-k1", "--ops", "score",
+         "--max-batch", "8", "--requests", "24", "--sizes", "1,3,7,2",
+         "--timeout-s", "30", "--log-dir", str(tmp_path / "runs")],
+        capture_output=True, text=True, timeout=600,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu",
+             "IWAE_COMPILE_CACHE": str(tmp_path / "cache")})
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith("{")]
+    warm = next(ln for ln in lines if "warmup" in ln)
+    snap = next(ln for ln in lines if "counters" in ln)
+    assert warm["warmup"]["programs"] == 4  # score x ladder(1,2,4,8)
+    c = snap["counters"]
+    assert c["completed"] == c["submitted"] > 0
+    assert c["aot_misses"] == 0 and c["recompiles"] == 0
+    assert snap["throughput_rows_per_sec"] > 0
+    assert any(k.startswith("score/") and v["p99_s"] is not None
+               for k, v in snap["latency"].items())
+    # the JSONL stamp landed through the shared MetricsLogger pipeline
+    jsonl = tmp_path / "runs" / "serving" / "metrics.jsonl"
+    assert jsonl.exists()
+    row = json.loads(jsonl.read_text().splitlines()[-1])
+    assert row["completed"] == c["completed"]
