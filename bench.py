@@ -405,6 +405,121 @@ def bench_serving():
         pass
 
 
+TELEMETRY_REPS = 5  # per mode; the off-vs-baseline delta must sit inside
+                    # the rep-to-rep spread (noise), per the telemetry PR bar
+
+
+def bench_telemetry():
+    """``--telemetry``: train-step overhead of on-device diagnostics, off vs on.
+
+    Three epoch programs on the flagship 2L IWAE-k50 shape, same data/key:
+
+    * **baseline** — ``make_epoch_fn`` without a diagnostics argument (the
+      pre-telemetry call shape);
+    * **off** — ``DiagnosticsConfig(enabled=False)`` passed explicitly: must
+      build the byte-identical program, so its throughput differs from
+      baseline only by run noise;
+    * **on** — ``DiagnosticsConfig(enabled=True)``: grad-moment accumulation
+      over the trailing ``snr_window`` steps inside the scan, plus the
+      per-eval estimator-diagnostics program measured separately.
+
+    Prints one JSON line and writes results/telemetry_bench.json. Sizes
+    shrink via ``BENCH_TELEMETRY_N_TRAIN`` for constrained hosts.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from iwae_replication_project_tpu.models import ModelConfig
+    from iwae_replication_project_tpu.objectives import ObjectiveSpec
+    from iwae_replication_project_tpu.telemetry.diagnostics import (
+        DiagnosticsConfig, estimator_diagnostics)
+    from iwae_replication_project_tpu.training import create_train_state
+    from iwae_replication_project_tpu.training.epoch import make_epoch_fn
+
+    on_tpu = any(d.platform == "tpu" for d in jax.devices())
+    n_train = int(os.environ.get("BENCH_TELEMETRY_N_TRAIN", 25600))
+    cfg = ModelConfig.two_layer(likelihood="logits", fused_likelihood=on_tpu,
+                                compute_dtype="bfloat16")
+    spec = ObjectiveSpec("IWAE", k=K)
+    x = jnp.asarray(make_data(n_train))
+    steps = n_train // BATCH
+
+    def build(diagnostics):
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        epoch = make_epoch_fn(spec, cfg, n_train, BATCH, donate=False,
+                              diagnostics=diagnostics)
+        out = epoch(state, x)            # compile + warmup
+        jax.block_until_ready(out)
+        return [epoch, out[0]]
+
+    # all three programs compile first, then the reps run ROUND-ROBIN across
+    # modes: slow host-load drift (thermal, co-tenants) hits every mode
+    # equally instead of biasing whichever mode was measured last
+    modes = {"baseline": build(None),
+             "off": build(DiagnosticsConfig(enabled=False)),
+             "on": build(DiagnosticsConfig(enabled=True, snr_window=50))}
+    rs = {name: [] for name in modes}
+    for _ in range(TELEMETRY_REPS):
+        for name, slot in modes.items():
+            epoch, state = slot
+            t0 = time.perf_counter()
+            out = epoch(state, x)
+            jax.block_until_ready(out)   # honest completion sync
+            rs[name].append(steps / (time.perf_counter() - t0))
+            slot[1] = out[0]
+    r_base, r_off, r_on = rs["baseline"], rs["off"], rs["on"]
+
+    # the per-eval weight-space diagnostics program, timed on its own: it
+    # rides the eval cadence (once per stage), not the train hot path
+    diag = DiagnosticsConfig(enabled=True, snr_window=50)
+    state = create_train_state(jax.random.PRNGKey(0), cfg)
+    eb = jnp.asarray(make_data(2000)).reshape(-1, EVAL_BATCH, 784)
+    key = jax.random.PRNGKey(1)
+    jax.block_until_ready(estimator_diagnostics(
+        state.params, cfg, key, eb, K, diag))
+    t0 = time.perf_counter()
+    jax.block_until_ready(estimator_diagnostics(
+        state.params, cfg, key, eb, K, diag))  # iwaelint: disable=key-reuse -- timing rep deliberately re-runs the IDENTICAL program (same key) so only dispatch variance is measured
+    diag_eval_s = time.perf_counter() - t0
+
+    base, off, on = (float(np.mean(r)) for r in (r_base, r_off, r_on))
+    noise = (max(r_base) - min(r_base)) / base
+    off_delta = abs(off - base) / base
+    out = {
+        "metric": "train-step overhead of on-device estimator diagnostics "
+                  "(IWAE-k50-2L, whole-epoch scan)",
+        "unit": "steps/sec",
+        "n_train": n_train, "batch": BATCH, "k": K,
+        "reps": TELEMETRY_REPS,
+        "steps_per_sec_baseline": round(base, 2),
+        "steps_per_sec_diag_off": round(off, 2),
+        "steps_per_sec_diag_on": round(on, 2),
+        "spread_baseline": {"min": round(min(r_base), 2),
+                            "max": round(max(r_base), 2)},
+        "spread_off": {"min": round(min(r_off), 2),
+                       "max": round(max(r_off), 2)},
+        "spread_on": {"min": round(min(r_on), 2),
+                      "max": round(max(r_on), 2)},
+        # the acceptance bar: off-mode == pre-PR program, so its delta vs
+        # baseline must be indistinguishable from run noise
+        "off_vs_baseline_rel_delta": round(off_delta, 4),
+        "run_noise_rel": round(noise, 4),
+        "off_within_noise": bool(off_delta <= max(noise, 0.02)),
+        "on_overhead_pct": round((base - on) / base * 100.0, 2),
+        "eval_diagnostics_seconds_per_eval": round(diag_eval_s, 4),
+        "snr_window": 50,
+    }
+    print(json.dumps(out))
+    res_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results")
+    try:
+        os.makedirs(res_dir, exist_ok=True)
+        with open(os.path.join(res_dir, "telemetry_bench.json"), "w") as f:
+            json.dump(out, f, indent=2)
+    except OSError:
+        pass
+
+
 MEMORY_CASES = ("flagship_train_dispatch", "eval_suite",
                 "widest_scaling_shape")
 
@@ -542,6 +657,9 @@ def main():
         return
     if "--serving" in sys.argv:
         bench_serving()
+        return
+    if "--telemetry" in sys.argv:
+        bench_telemetry()
         return
     rates, rates_f32, eval_rates, compile_info = bench_jax()
     base_sps, base_n = bench_baseline()

@@ -38,6 +38,8 @@ from iwae_replication_project_tpu.utils.compile_cache import (
     stats_delta,
     warm_callable,
 )
+from iwae_replication_project_tpu.telemetry.registry import get_registry
+from iwae_replication_project_tpu.telemetry.spans import span
 from iwae_replication_project_tpu.utils.config import ExperimentConfig
 from iwae_replication_project_tpu.utils.logging import MetricsLogger
 
@@ -133,6 +135,9 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     # cache active, donation is dropped (see utils/compile_cache.py)
     donate = cfg.donate_buffers and donation_safe()
     mesh_key = mesh_fingerprint(mesh)
+    # the DiagnosticsConfig gate (telemetry/diagnostics.py): a jit static
+    # AND part of the AOT build key — on/off are distinct compiled programs
+    diag_cfg = cfg.diagnostics_config()
 
     def epoch_fn_for(active_spec, epochs_per_call=1):
         cache_key = (active_spec, epochs_per_call)
@@ -144,19 +149,19 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
                 active_spec, model_cfg, mesh, n_train, cfg.batch_size,
                 stochastic_binarization=stoch_bin,
                 optimizer=opt, donate=donate,
-                epochs_per_call=epochs_per_call)
+                epochs_per_call=epochs_per_call, diagnostics=diag_cfg)
         else:
             from iwae_replication_project_tpu.training.epoch import make_epoch_fn
             fn = make_epoch_fn(
                 active_spec, model_cfg, n_train, cfg.batch_size,
                 stochastic_binarization=stoch_bin,
                 optimizer=opt, donate=donate,
-                epochs_per_call=epochs_per_call)
+                epochs_per_call=epochs_per_call, diagnostics=diag_cfg)
         fn = warm_callable(
             "parallel_epoch" if mesh is not None else "epoch", fn,
             build_key=(active_spec, model_cfg, epochs_per_call, n_train,
                        cfg.batch_size, stoch_bin, donate,
-                       cfg.adam_eps, mesh_key))
+                       cfg.adam_eps, mesh_key, diag_cfg))
         _fn_cache[cache_key] = fn
         return fn
 
@@ -201,6 +206,7 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     # lazy: a resumed-already-complete run must not touch the run directory
     # at all (no fresh tfevents file, no figure/pkl rewrites)
     logger = None
+    telem_logger = None
     eval_key = jax.random.PRNGKey(cfg.seed + 10_000)
     x_test = ds.x_test[:eval_subset] if eval_subset else ds.x_test
     y_test = None
@@ -247,20 +253,26 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
 
         t_train = time.perf_counter()
         remaining = passes - offset
-        if remaining >= PASS_BLOCK and max_batches_per_pass is None:
-            block_fn = epoch_fn_for(active_spec, PASS_BLOCK)
-            for _ in range(remaining // PASS_BLOCK):
-                state, _ = block_fn(state, x_train_dev)
-                done += PASS_BLOCK
-                since_save += PASS_BLOCK
+        last_diag = None  # device scalars from the newest epoch dispatch
+        with span("train/stage"):
+            if remaining >= PASS_BLOCK and max_batches_per_pass is None:
+                block_fn = epoch_fn_for(active_spec, PASS_BLOCK)
+                for _ in range(remaining // PASS_BLOCK):
+                    state, out = block_fn(state, x_train_dev)
+                    if diag_cfg is not None:
+                        _, last_diag = out
+                    done += PASS_BLOCK
+                    since_save += PASS_BLOCK
+                    maybe_save_mid_stage()
+                remaining = remaining % PASS_BLOCK
+            epoch_fn = epoch_fn_for(active_spec)
+            for _ in range(remaining):
+                state, out = epoch_fn(state, x_train_dev)
+                if diag_cfg is not None:
+                    _, last_diag = out
+                done += 1
+                since_save += 1
                 maybe_save_mid_stage()
-            remaining = remaining % PASS_BLOCK
-        epoch_fn = epoch_fn_for(active_spec)
-        for _ in range(remaining):
-            state, _ = epoch_fn(state, x_train_dev)
-            done += 1
-            since_save += 1
-            maybe_save_mid_stage()
         # fetch forces completion of the async dispatches (np.asarray under
         # the hood — block_until_ready only reports enqueue on remote
         # transports), so the stage timings are honest train/eval splits
@@ -268,23 +280,61 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         train_s = time.perf_counter() - t_train
 
         t_eval = time.perf_counter()
-        if mesh is not None:
-            from iwae_replication_project_tpu.parallel.eval import (
-                parallel_training_statistics)
-            res, res2 = parallel_training_statistics(
-                state.params, model_cfg, mesh,
-                jax.random.fold_in(eval_key, stage),
-                jax.numpy.asarray(x_test.reshape(len(x_test), -1)),
-                cfg.eval_k, batch_size=min(cfg.eval_batch_size, len(x_test)),
-                nll_k=cfg.nll_k, nll_chunk=cfg.nll_chunk,
-                activity_samples=cfg.activity_samples)
-        else:
-            res, res2 = ev.training_statistics(
-                state.params, model_cfg, jax.random.fold_in(eval_key, stage),
-                jax.numpy.asarray(x_test.reshape(len(x_test), -1)),
-                cfg.eval_k, batch_size=min(cfg.eval_batch_size, len(x_test)),
-                nll_k=cfg.nll_k, nll_chunk=cfg.nll_chunk,
-                activity_samples=cfg.activity_samples)
+        with span("eval/statistics"):
+            if mesh is not None:
+                from iwae_replication_project_tpu.parallel.eval import (
+                    parallel_training_statistics)
+                res, res2 = parallel_training_statistics(
+                    state.params, model_cfg, mesh,
+                    jax.random.fold_in(eval_key, stage),
+                    jax.numpy.asarray(x_test.reshape(len(x_test), -1)),
+                    cfg.eval_k,
+                    batch_size=min(cfg.eval_batch_size, len(x_test)),
+                    nll_k=cfg.nll_k, nll_chunk=cfg.nll_chunk,
+                    activity_samples=cfg.activity_samples)
+            else:
+                res, res2 = ev.training_statistics(
+                    state.params, model_cfg,
+                    jax.random.fold_in(eval_key, stage),
+                    jax.numpy.asarray(x_test.reshape(len(x_test), -1)),
+                    cfg.eval_k,
+                    batch_size=min(cfg.eval_batch_size, len(x_test)),
+                    nll_k=cfg.nll_k, nll_chunk=cfg.nll_chunk,
+                    activity_samples=cfg.activity_samples)
+        # estimator diagnostics (telemetry/diagnostics.py): the weight-space
+        # suite as one extra device program per eval, plus the train-side
+        # grad-SNR scalars the newest epoch dispatch carried — fetched here,
+        # with everything else, never per step. Multihost runs skip the eval
+        # program (params are not single-process-addressable; the replicated
+        # grad-SNR scalars still flow).
+        if diag_cfg is not None:
+            diag_vals = {}
+            if not cfg.multihost:
+                from iwae_replication_project_tpu.telemetry.diagnostics import (
+                    estimator_diagnostics)
+                from iwae_replication_project_tpu.utils.compile_cache import (
+                    aot_call)
+                n_eval = len(x_test)
+                ebs = ev.largest_divisor_leq(
+                    n_eval, min(cfg.eval_batch_size, n_eval))
+                ebatches = jax.numpy.asarray(
+                    x_test.reshape(n_eval // ebs, ebs, -1))
+                with span("eval/diagnostics"):
+                    diag_vals.update(fetch(aot_call(
+                        "estimator_diagnostics", estimator_diagnostics,
+                        (state.params,),
+                        kwargs=dict(key=jax.random.fold_in(eval_key,
+                                                           30_000 + stage),
+                                    batches=ebatches),
+                        static_kwargs=dict(cfg=model_cfg, k=cfg.eval_k,
+                                           diag=diag_cfg),
+                        build_key=(model_cfg, cfg.eval_k, diag_cfg))))
+            if last_diag is not None:
+                diag_vals.update(fetch(last_diag))
+            res.update({k: float(v) for k, v in diag_vals.items()})
+            reg = get_registry()
+            for k, v in diag_vals.items():
+                reg.gauge(k).set(float(v))
         res["learning_rate"] = lr
         res["stage"] = stage
         # make fake-data runs unmistakable in every artifact (metrics.jsonl,
@@ -324,6 +374,17 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
             "number_of_active_units": res2["number_of_active_units"],
             "number_of_PCA_active_units": res2["number_of_PCA_active_units"]}))
         if logger is not None:  # primary process only under --multihost
+            # registry export (span timings, diagnostic gauges, aot counters)
+            # lands in its own runs/<run>/telemetry/ stream: metrics.jsonl
+            # keeps one row per stage — the schema every downstream consumer
+            # (plot scripts, replication driver, tests) keys on — and the
+            # telemetry stream shows up in TensorBoard as a <run>/telemetry
+            # subrun next to it
+            if diag_cfg is not None:
+                if telem_logger is None:
+                    telem_logger = MetricsLogger(logger.dir,
+                                                 run_name="telemetry")
+                telem_logger.log_registry(get_registry(), step=step_n)
             logger.log(res, step=step_n)
             if cfg.save_figures:
                 from iwae_replication_project_tpu.utils.viz import (
@@ -347,6 +408,8 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         save_checkpoint(ckpt_dir, step_n, state, stage,
                         config_json=cfg.to_json(), keep=cfg.checkpoint_keep)
 
+    if telem_logger is not None:
+        telem_logger.close()
     if logger is not None:
         logger.close()
     return state, results_history

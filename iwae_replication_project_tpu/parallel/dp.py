@@ -267,7 +267,8 @@ def make_parallel_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
                            stochastic_binarization: bool = False,
                            optimizer: optax.GradientTransformation | None = None,
                            shuffle: bool = True, donate: bool = True,
-                           epochs_per_call: int = 1):
+                           epochs_per_call: int = 1,
+                           diagnostics=None):
     """Whole-epoch training under the mesh: ONE dispatch per data pass.
 
     The single-device path already runs each epoch as one `lax.scan`
@@ -285,7 +286,16 @@ def make_parallel_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
     Returns ``epoch(state, x_train_replicated) -> (state, per-batch losses)``.
     ``epochs_per_call > 1`` scans that many consecutive epochs inside the one
     dispatch (losses concatenated), exactly like training/epoch.py.
+
+    `diagnostics` (a telemetry DiagnosticsConfig) mirrors the single-device
+    contract: the second return value becomes ``(losses, grad-SNR scalars)``.
+    The grads `vg` yields are already globally reduced (psum over sp, pmean
+    over dp), so the windowed moment accumulators are replicated and the SNR
+    scalars come out identical on every device — out_specs P().
     """
+    from iwae_replication_project_tpu.telemetry.diagnostics import (
+        grad_accum_init, grad_accum_update, grad_snr_summary)
+
     opt = optimizer if optimizer is not None else make_adam()
     n_sp, k_local = _validate_sharding(spec, mesh, batch_size)
     n_dp = mesh.shape[AXES.dp]
@@ -294,6 +304,8 @@ def make_parallel_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
         raise ValueError(f"batch_size={batch_size} exceeds n_train={n_train}")
     if epochs_per_call < 1:
         raise ValueError(f"epochs_per_call={epochs_per_call} must be >= 1")
+    diag_on = diagnostics is not None and diagnostics.enabled
+    window = min(diagnostics.snr_window, n_batches) if diag_on else 0
     b_local = batch_size // n_dp
     vg = _make_local_value_and_grad(spec, cfg, n_sp, k_local)
 
@@ -306,8 +318,7 @@ def make_parallel_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
         idx = perm[: n_batches * batch_size].reshape(n_batches, batch_size)
         i_dp = lax.axis_index(AXES.dp)
 
-        def body(st, xs):
-            batch_idx, i = xs
+        def step(st, batch_idx, i):
             local_idx = lax.dynamic_slice(batch_idx, (i_dp * b_local,), (b_local,))
             batch = x_train[local_idx]
             if stochastic_binarization:
@@ -318,19 +329,41 @@ def make_parallel_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
             neg = jax.tree.map(jnp.negative, grads)
             updates, opt_state = opt.update(neg, st.opt_state, st.params)
             params = optax.apply_updates(st.params, updates)
-            return TrainState(params, opt_state, st.key, st.step + 1), -bound
+            return (TrainState(params, opt_state, st.key, st.step + 1),
+                    -bound, grads)
 
-        state, losses = lax.scan(body, state, (idx, jnp.arange(n_batches)))
-        return state._replace(key=key_next), losses
+        if not diag_on:
+            def body(st, xs):
+                st, loss, _ = step(st, *xs)
+                return st, loss
+
+            state, losses = lax.scan(body, state, (idx, jnp.arange(n_batches)))
+            return state._replace(key=key_next), losses
+
+        def body(carry, xs):
+            st, acc = carry
+            st, loss, grads = step(st, *xs)
+            include = (xs[1] >= n_batches - window).astype(jnp.float32)
+            return (st, grad_accum_update(acc, grads, include)), loss
+
+        (state, (s1, s2)), losses = lax.scan(
+            body, (state, grad_accum_init(state.params)),
+            (idx, jnp.arange(n_batches)))
+        return (state._replace(key=key_next),
+                (losses, grad_snr_summary(s1, s2, window)))
 
     if epochs_per_call == 1:
         local_fn = epoch_local
     else:
         def local_fn(state, x_train):
-            state, losses = lax.scan(
+            state, out = lax.scan(
                 lambda st, _: epoch_local(st, x_train), state,
                 None, length=epochs_per_call)
-            return state, losses.reshape(-1)
+            if not diag_on:
+                return state, out.reshape(-1)
+            losses, diag = out
+            return state, (losses.reshape(-1),
+                           jax.tree.map(lambda a: a[-1], diag))
 
     # stable program name -> attributable persistent-cache entries / traces
     local_fn.__name__ = local_fn.__qualname__ = (
@@ -342,7 +375,9 @@ def make_parallel_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    from iwae_replication_project_tpu.telemetry.spans import spanned
+    return spanned(jax.jit(sharded, donate_argnums=(0,) if donate else ()),
+                   "train/parallel_epoch")
 
 
 def make_parallel_train_step(spec: ObjectiveSpec, cfg: model.ModelConfig, mesh,

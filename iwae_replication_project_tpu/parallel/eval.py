@@ -340,8 +340,10 @@ def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
     # the replicated outputs are not fully addressable and plain np.asarray
     # raises; in single-process runs fetch is equivalent to np.asarray
     from iwae_replication_project_tpu.parallel.multihost import fetch
+    from iwae_replication_project_tpu.telemetry.spans import span
 
-    scalars = np.asarray(fetch(scalars_fn(params, key, batches)))  # iwaelint: disable=host-sync -- end of the fused eval suite: the ONE deliberate fetch that realizes all scalars at once
+    with span("eval/scalars"):
+        scalars = np.asarray(fetch(scalars_fn(params, key, batches)))  # iwaelint: disable=host-sync -- end of the fused eval suite: the ONE deliberate fetch that realizes all scalars at once
     acc = {name: float(v) for name, v in zip(SCALAR_NAMES, scalars)}
     # the per-DEVICE chunk actually used (clamped against nll_k/sp inside
     # make_parallel_dataset_scalars) — the eval-RNG version stamp
@@ -350,7 +352,9 @@ def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
 
     res2: Dict[str, object] = {}
     k_au, k_pruned = jax.random.split(jax.random.fold_in(key, n_batches))
-    means = fetch(means_fn(params, k_au, jnp.asarray(x_test.reshape(n, -1))))
+    with span("eval/activity"):
+        means = fetch(means_fn(params, k_au,
+                               jnp.asarray(x_test.reshape(n, -1))))
     variances = tuple(jnp.var(m, axis=0) for m in means)
     eigvals = tuple(au.pca_eigenvalues(m) for m in means)
     masks, n_active, n_active_pca = au.active_units(variances, eigvals,
@@ -363,7 +367,8 @@ def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
     if include_pruned_nll:
         pruned_fn = make_parallel_pruned_nll(cfg, mesh, nll_k, nll_chunk,
                                              n_layers=cfg.n_stochastic)
-        acc["LL_pruned"] = float(fetch(pruned_fn(params, k_pruned,
-                                                 jnp.asarray(batches[0]),
-                                                 *masks)))
+        with span("eval/pruned_nll"):
+            acc["LL_pruned"] = float(fetch(pruned_fn(params, k_pruned,
+                                                     jnp.asarray(batches[0]),
+                                                     *masks)))
     return acc, res2

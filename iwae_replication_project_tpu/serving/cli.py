@@ -14,6 +14,11 @@ Weights come from ``--checkpoint RUN_DIR`` (an experiment run directory) or
 are freshly initialized from ``--preset NAME`` / the flagship default —
 untrained, which is fine for load/latency work and makes the CLI runnable in
 a zero-data container.
+
+``--metrics-port PORT`` additionally serves the engine's telemetry registry
+(counters, per-bucket latency histograms, serve/aot spans) as a Prometheus
+text page at ``http://127.0.0.1:PORT/metrics`` for the lifetime of the
+process (telemetry/exporters.py).
 """
 
 from __future__ import annotations
@@ -62,6 +67,13 @@ def build_argparser() -> argparse.ArgumentParser:
     ap.add_argument("--log-dir", dest="log_dir", type=str, default=None,
                     help="also stamp the metrics snapshot as JSONL/TB under "
                          "this directory (utils/logging.MetricsLogger)")
+    ap.add_argument("--metrics-port", dest="metrics_port", type=int,
+                    default=None,
+                    help="serve a Prometheus text snapshot of the engine's "
+                         "metric registry (+ process spans) at "
+                         "http://127.0.0.1:PORT/metrics (0 = pick an "
+                         "ephemeral port, printed in the warmup line; "
+                         "omit = off)")
     return ap
 
 
@@ -156,12 +168,25 @@ def main(argv=None) -> int:
     eng = _build_engine(args)
     ops = tuple(s for s in args.ops.split(",") if s)
     warm = eng.warmup(ops=ops)
+    metrics_srv = None
+    if args.metrics_port is not None:
+        from iwae_replication_project_tpu.telemetry import (
+            get_registry, start_metrics_server)
+        # engine registry (counters, per-bucket latency, serve/* spans) plus
+        # the process-default registry (aot/* dispatch spans)
+        metrics_srv = start_metrics_server(
+            (get_registry(), eng.metrics.registry), args.metrics_port)
     print(json.dumps({"warmup": warm,
                       "buckets": list(eng.ladder.buckets),
-                      "k": eng.k}), flush=True)
+                      "k": eng.k,
+                      "metrics_port": (metrics_srv.server_address[1]
+                                       if metrics_srv else None)}),
+          flush=True)
 
     if args.interactive:
         _interactive(eng, args)
+        if metrics_srv is not None:
+            metrics_srv.shutdown()
         return 0
     snap = _synthetic_load(eng, ops, args)
     print(json.dumps(snap), flush=True)
@@ -171,6 +196,8 @@ def main(argv=None) -> int:
         logger.log(eng.metrics.flat(),
                    step=int(snap["counters"]["dispatches"]))
         logger.close()
+    if metrics_srv is not None:
+        metrics_srv.shutdown()
     return 0
 
 

@@ -281,6 +281,7 @@ class ServingEngine:
         return (op, self.cfg, k, bucket)
 
     def _dispatch(self, batch: List[Request]) -> None:
+        from iwae_replication_project_tpu.telemetry.spans import span
         from iwae_replication_project_tpu.utils.compile_cache import (
             aot_call, cache_stats, stats_delta)
 
@@ -295,9 +296,13 @@ class ServingEngine:
         args, kwargs, static = self._dispatch_args(op, k, payload, seeds)
         s0 = cache_stats()
         try:
-            out = np.asarray(aot_call(f"serve_{op}", program, args,
-                                      kwargs=kwargs, static_kwargs=static,
-                                      build_key=self._build_key(op, k, bucket)))
+            # spans nest: serve/dispatch/aot/serve_<op> — the outer one (in
+            # the engine's own registry) covers pad+device_put+execute+fetch
+            with span(f"serve/dispatch/{op}", registry=self.metrics.registry):
+                out = np.asarray(aot_call(
+                    f"serve_{op}", program, args,
+                    kwargs=kwargs, static_kwargs=static,
+                    build_key=self._build_key(op, k, bucket)))
         except Exception as e:  # dispatch failure -> per-request error,
             for r in batch:     # never a dead dispatcher thread
                 self.metrics.count("errors")
@@ -327,6 +332,7 @@ class ServingEngine:
         runs with zero compiles (the bench's ``cache_stats`` delta proves
         it). Returns ``{"programs": N, "compiles": M, "seconds": S}``
         (programs > compiles when some rungs were already registered)."""
+        from iwae_replication_project_tpu.telemetry.spans import span
         from iwae_replication_project_tpu.utils.compile_cache import (
             aot_warm, cache_stats, stats_delta)
 
@@ -334,21 +340,22 @@ class ServingEngine:
         s0 = cache_stats()
         t0 = time.perf_counter()
         n_programs = 0
-        for op in ops:
-            if op not in PROGRAMS:
-                raise ValueError(f"unknown op {op!r}")
-            program, takes_k = PROGRAMS[op]
-            for k in (ks if takes_k else [0]):
-                for bucket in self.ladder.buckets:
-                    payload = np.zeros((bucket, self.row_dims[op]),
-                                       np.float32)
-                    seeds = np.zeros((bucket,), np.int32)
-                    args, kwargs, static = self._dispatch_args(
-                        op, k, payload, seeds)
-                    aot_warm(f"serve_{op}", program, args, kwargs=kwargs,
-                             static_kwargs=static,
-                             build_key=self._build_key(op, k, bucket))
-                    n_programs += 1
+        with span("serve/warmup", registry=self.metrics.registry):
+            for op in ops:
+                if op not in PROGRAMS:
+                    raise ValueError(f"unknown op {op!r}")
+                program, takes_k = PROGRAMS[op]
+                for k in (ks if takes_k else [0]):
+                    for bucket in self.ladder.buckets:
+                        payload = np.zeros((bucket, self.row_dims[op]),
+                                           np.float32)
+                        seeds = np.zeros((bucket,), np.int32)
+                        args, kwargs, static = self._dispatch_args(
+                            op, k, payload, seeds)
+                        aot_warm(f"serve_{op}", program, args, kwargs=kwargs,
+                                 static_kwargs=static,
+                                 build_key=self._build_key(op, k, bucket))
+                        n_programs += 1
         d = stats_delta(s0)
         return {"programs": float(n_programs),
                 "compiles": float(d["aot_misses"]),

@@ -1,12 +1,15 @@
-"""Serving observability: per-bucket latency histograms + engine counters.
+"""Serving observability: engine counters + per-bucket latency histograms,
+backed by the telemetry registry (telemetry/registry.py).
 
-Dependency-free streaming histograms (fixed log-spaced bins, O(1) per
-record) rather than reservoirs: a serving engine must account *every*
-request at heavy load, and p99 from log-spaced bins is within one bin width
-(~33%) of truth at any traffic volume — the right trade for a gauge that
-steers shedding policy.
+This module used to carry its own log-spaced histogram/percentile code; that
+implementation now lives ONCE in :class:`~..telemetry.registry.Histogram`
+and :class:`ServingMetrics` is a thin schema adapter over a
+:class:`~..telemetry.registry.MetricRegistry` — per-engine by default (two
+engines must not share counters), injectable for tests or co-export. The
+``iwae-serve`` CLI serves the same registry as a Prometheus text page
+(``--metrics-port``; telemetry/exporters.py).
 
-Two export surfaces, both consistent with utils/logging.py:
+Export surfaces, unchanged schema:
 
 * :meth:`ServingMetrics.snapshot` — the nested JSON document (CLI
   ``--stats``, bench artifacts);
@@ -17,59 +20,29 @@ Two export surfaces, both consistent with utils/logging.py:
 
 from __future__ import annotations
 
-import math
-import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Optional
 
-#: histogram bin geometry: 8 bins per decade from 1 us to 1000 s (+overflow)
-_BINS_PER_DECADE = 8
-_MIN_S = 1e-6
-_DECADES = 9
-_N_BINS = _BINS_PER_DECADE * _DECADES + 1
+from iwae_replication_project_tpu.telemetry.registry import (
+    Histogram,
+    MetricRegistry,
+)
 
-
-def _bin_index(seconds: float) -> int:
-    if seconds <= _MIN_S:
-        return 0
-    i = int(math.log10(seconds / _MIN_S) * _BINS_PER_DECADE)
-    return min(i, _N_BINS - 1)
+#: registry namespace for the per-(op, bucket) histograms
+_LAT = "latency/"
 
 
-def _bin_upper(i: int) -> float:
-    return _MIN_S * 10.0 ** ((i + 1) / _BINS_PER_DECADE)
+class LatencyHistogram(Histogram):
+    """Seconds-unit view of the shared log-spaced histogram: same bins
+    (8/decade, 1 us .. 1000 s), summary keys carry the ``_s`` suffix the
+    serving snapshot schema pins."""
 
-
-class LatencyHistogram:
-    """Log-spaced latency histogram with percentile readout."""
-
-    def __init__(self):
-        self.counts: List[int] = [0] * _N_BINS
-        self.n = 0
-        self.total_s = 0.0
-
-    def record(self, seconds: float) -> None:
-        self.counts[_bin_index(seconds)] += 1
-        self.n += 1
-        self.total_s += seconds
-
-    def percentile(self, q: float) -> Optional[float]:
-        """Upper bound of the bin holding the q-quantile (q in [0, 1])."""
-        if self.n == 0:
-            return None
-        target = q * self.n
-        seen = 0
-        for i, c in enumerate(self.counts):
-            seen += c
-            if seen >= target:
-                return _bin_upper(i)
-        return _bin_upper(_N_BINS - 1)
+    def __init__(self, lock=None):
+        super().__init__(lock)
 
     def summary(self) -> Dict[str, Optional[float]]:
-        mean = self.total_s / self.n if self.n else None
-        return {"count": self.n, "mean_s": mean,
-                "p50_s": self.percentile(0.50),
-                "p95_s": self.percentile(0.95),
-                "p99_s": self.percentile(0.99)}
+        s = super().summary()
+        return {"count": s["count"], "mean_s": s["mean"], "p50_s": s["p50"],
+                "p95_s": s["p95"], "p99_s": s["p99"]}
 
 
 class ServingMetrics:
@@ -79,25 +52,26 @@ class ServingMetrics:
                 "dispatches", "real_rows", "padded_rows",
                 "aot_hits", "aot_misses", "recompiles")
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._c: Dict[str, float] = {k: 0 for k in self.COUNTERS}
-        self._hist: Dict[Tuple[str, int], LatencyHistogram] = {}
-        self.queue_depth = 0          # gauge, engine-maintained
+    def __init__(self, registry: Optional[MetricRegistry] = None):
+        self.registry = registry if registry is not None else MetricRegistry()
+        # pre-register so snapshots carry every counter from the first call
+        for name in self.COUNTERS:
+            self.registry.counter(name)
+        self._queue_depth = self.registry.gauge("queue_depth")
 
     def count(self, name: str, n: float = 1) -> None:
-        with self._lock:
-            self._c[name] += n
+        self.registry.counter(name).inc(n)
 
     def set_queue_depth(self, depth: int) -> None:
-        self.queue_depth = int(depth)
+        self._queue_depth.set(int(depth))
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self._queue_depth.value)
 
     def record_latency(self, op: str, bucket: int, seconds: float) -> None:
-        with self._lock:
-            h = self._hist.get((op, bucket))
-            if h is None:
-                h = self._hist[(op, bucket)] = LatencyHistogram()
-            h.record(seconds)
+        self.registry.histogram(f"{_LAT}{op}/b{bucket}",
+                                factory=LatencyHistogram).record(seconds)
 
     # -- export ------------------------------------------------------------
 
@@ -106,16 +80,16 @@ class ServingMetrics:
         latency summaries. Padding waste = fraction of dispatched rows that
         were filler (the cost of the bucket ladder; high values mean the
         ladder is too coarse for the observed size mix)."""
-        with self._lock:
-            c = dict(self._c)
-            hists = {f"{op}/b{bucket}": h.summary()
-                     for (op, bucket), h in sorted(self._hist.items())}
+        snap = self.registry.snapshot()
+        c = {k: snap["counters"].get(k, 0) for k in self.COUNTERS}
         rows = c["real_rows"] + c["padded_rows"]
         return {
             "counters": c,
-            "queue_depth": self.queue_depth,
+            "queue_depth": int(snap["gauges"].get("queue_depth", 0)),
             "padding_waste": (c["padded_rows"] / rows) if rows else 0.0,
-            "latency": hists,
+            "latency": {name[len(_LAT):]: s
+                        for name, s in snap["histograms"].items()
+                        if name.startswith(_LAT)},
         }
 
     def flat(self) -> Dict[str, float]:

@@ -1,0 +1,38 @@
+"""Unified telemetry: metric registry, span tracing, on-device diagnostics.
+
+Import surface (kept light — :mod:`.diagnostics` pulls the model stack and
+is imported explicitly by the call sites that compute diagnostics):
+
+* :mod:`.registry` — counters / gauges / log-spaced histograms behind one
+  process-default :class:`MetricRegistry` (:func:`get_registry`);
+* :mod:`.spans` — nested host-side :func:`span` timing that lands in the
+  registry AND in ``jax.profiler`` traces under the same names;
+* :mod:`.exporters` — Prometheus text page + the ``/metrics`` HTTP endpoint
+  (JSONL/TensorBoard export rides :class:`~..utils.logging.MetricsLogger`);
+* :mod:`.diagnostics` — :class:`DiagnosticsConfig`-gated ESS / log-weight
+  variance / gradient-SNR / active-units reductions that run inside the
+  jitted train/eval programs.
+"""
+
+from iwae_replication_project_tpu.telemetry.exporters import (
+    prometheus_text,
+    start_metrics_server,
+)
+from iwae_replication_project_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    get_registry,
+)
+from iwae_replication_project_tpu.telemetry.spans import (
+    current_span,
+    span,
+    spanned,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricRegistry", "get_registry",
+    "current_span", "span", "spanned",
+    "prometheus_text", "start_metrics_server",
+]

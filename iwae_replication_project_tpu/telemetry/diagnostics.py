@@ -1,0 +1,206 @@
+"""On-device estimator diagnostics: is the K-sample bound actually healthy?
+
+Two papers define what to watch (PAPERS.md):
+
+* Rainforth et al. ("Tighter Variational Bounds are Not Necessarily
+  Better") — the quantity that decides whether a K-sample objective
+  *trains* is the gradient **signal-to-noise ratio** SNR = |E[g]| / sigma[g],
+  which for the IWAE encoder *decays* as K grows;
+* "Reinterpreting Importance-Weighted Autoencoders" (arXiv:1704.02916) —
+  IWAE is self-normalized importance sampling, whose health metric is the
+  **effective sample size** of the K weights,
+  ``ESS = (sum w)^2 / sum w^2``: ESS ~ K means the posterior is
+  well-covered; ESS ~ 1 means one sample dominates and the bound is tight
+  only on paper.
+
+Everything here runs INSIDE the jitted train/eval programs — pure ``jnp``
+reductions of tensors those programs already materialize (the ``[k, B]``
+log-weights, the per-step grads), so enabling diagnostics adds reductions to
+the device graph and **zero extra host syncs**: results ride the same
+per-stage fetch the driver already performs. :class:`DiagnosticsConfig` is a
+frozen (hashable -> jit-static) gate; with it absent/off every call site
+compiles the byte-identical pre-diagnostics program.
+
+Scalars emitted (the ``diag/`` namespace in metrics.jsonl / TensorBoard /
+the registry):
+
+=====================  ====================================================
+``diag/ess``           mean over datapoints of ESS of the K weights
+``diag/ess_frac``      same, normalized by K (1.0 = perfect coverage)
+``diag/log_weight_var`` mean over datapoints of Var_k[log w]
+``diag/kl_q_p``        MC estimate of E_q[log q(h|x) - log p(h)]
+``diag/active_units``  latent units with Var_B[E_q[h|x]] > threshold
+``diag/active_frac``   same, normalized by the total latent width
+``diag/grad_snr``      mean over parameters of |E[g]| / sigma[g] over the
+                       trailing ``snr_window`` optimizer steps (per the
+                       objective's sample count K — Rainforth-style)
+``diag/grad_snr_enc``  encoder-subtree mean (the one Rainforth predicts
+``diag/grad_snr_dec``  decays with K); decoder+output-subtree mean
+=====================  ====================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from iwae_replication_project_tpu.models import iwae as model
+
+_SNR_EPS = 1e-12
+
+
+@dataclasses.dataclass(frozen=True)
+class DiagnosticsConfig:
+    """Hashable gate + knobs (frozen -> usable as a jit static / build key).
+
+    ``enabled=False`` (or passing ``None`` where a config is accepted) must
+    leave every hot path byte-identical to the pre-diagnostics program —
+    bench.py ``--telemetry`` measures exactly that contract.
+    """
+
+    enabled: bool = True
+    #: trailing optimizer steps in the gradient-SNR moment estimate (clamped
+    #: to the steps one epoch dispatch actually runs)
+    snr_window: int = 50
+    #: posterior-mean variance threshold for the active-units count (the
+    #: evaluation suite's 0.01 convention, Burda et al.)
+    active_threshold: float = 0.01
+
+    def __post_init__(self):
+        # window 0 would make the SNR moments divide by zero -> silent NaN
+        # rows (and an abort under the debug_nans sanitize profile)
+        if self.snr_window < 1:
+            raise ValueError(
+                f"snr_window must be >= 1, got {self.snr_window}")
+
+
+# ---------------------------------------------------------------------------
+# weight-space diagnostics: pure reductions of the [k, B] log-weights
+# ---------------------------------------------------------------------------
+
+def ess(log_w: jnp.ndarray) -> jnp.ndarray:
+    """Effective sample size of the K self-normalized weights, per datapoint.
+
+    ``ESS = (sum_k w)^2 / sum_k w^2 = exp(2 lse(log w) - lse(2 log w))``,
+    computed in log space so it is exact under the same max-stabilization
+    the bound itself uses. Range ``[1, k]``: k for uniform weights, ->1 as
+    one sample dominates.
+    """
+    lse1 = jax.nn.logsumexp(log_w, axis=0)
+    lse2 = jax.nn.logsumexp(2.0 * log_w, axis=0)
+    return jnp.exp(2.0 * lse1 - lse2)
+
+
+def weight_diagnostics(log_w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Batch-mean ESS / ESS-fraction / log-weight variance of one pass."""
+    k = log_w.shape[0]
+    e = jnp.mean(ess(log_w))
+    return {"diag/ess": e, "diag/ess_frac": e / k,
+            "diag/log_weight_var": jnp.mean(jnp.var(log_w, axis=0))}
+
+
+# ---------------------------------------------------------------------------
+# gradient SNR: trailing-window moment accumulation inside the epoch scan
+# ---------------------------------------------------------------------------
+
+def grad_accum_init(params) -> Tuple:
+    """Zeroed ``(sum g, sum g^2)`` accumulator trees for the scan carry."""
+    zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    return zeros, jax.tree.map(jnp.copy, zeros)
+
+
+def grad_accum_update(acc: Tuple, grads, include: jnp.ndarray) -> Tuple:
+    """Fold one step's grads in, weighted by `include` (0/1 window mask)."""
+    s1, s2 = acc
+    s1 = jax.tree.map(lambda a, g: a + include * g, s1, grads)
+    s2 = jax.tree.map(lambda a, g: a + include * (g * g), s2, grads)
+    return s1, s2
+
+
+def _subtree_snr(sum_g, sum_sq, n: int) -> jnp.ndarray:
+    """Mean over parameters of |mean| / std from the accumulated moments."""
+    tot = jnp.zeros((), jnp.float32)
+    count = 0
+    for g, q in zip(jax.tree.leaves(sum_g), jax.tree.leaves(sum_sq)):
+        m = g / n
+        var = jnp.maximum(q / n - m * m, 0.0)
+        tot = tot + jnp.sum(jnp.abs(m) / jnp.sqrt(var + _SNR_EPS))
+        count += g.size
+    return tot / count
+
+
+def grad_snr_summary(sum_g, sum_sq, n: int) -> Dict[str, jnp.ndarray]:
+    """Rainforth-style SNR scalars from windowed first/second grad moments.
+
+    `sum_g`/`sum_sq` are params-shaped trees (``{"enc", "dec", "out"}``);
+    the encoder subtree is reported separately because that is the gradient
+    Rainforth et al. predict degrades as K grows, while the decoder's
+    improves.
+    """
+    dec = ({"dec": sum_g["dec"], "out": sum_g["out"]},
+           {"dec": sum_sq["dec"], "out": sum_sq["out"]})
+    return {
+        "diag/grad_snr": _subtree_snr(sum_g, sum_sq, n),
+        "diag/grad_snr_enc": _subtree_snr(sum_g["enc"], sum_sq["enc"], n),
+        "diag/grad_snr_dec": _subtree_snr(*dec, n),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the per-eval diagnostics program: one scan over the test batches
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("cfg", "k", "diag"))
+def estimator_diagnostics(params, cfg: model.ModelConfig, key: jax.Array,
+                          batches: jax.Array, k: int,
+                          diag: DiagnosticsConfig) -> Dict[str, jax.Array]:
+    """Weight-space + KL + active-units diagnostics over ``[n_batches, B, d]``
+    test batches as ONE device program (the driver routes it through the AOT
+    registry next to ``dataset_scalars``). Returns a dict of scalars.
+
+    The active-units estimate here is the cheap in-graph version — variance
+    across datapoints of the per-datapoint posterior mean (mean over the k
+    samples the diagnostics pass already drew). The evaluation suite's
+    dedicated estimator (evaluation/activity.py, fresh MC samples + PCA)
+    remains the reference number; this one rides along at zero extra passes.
+    """
+    n_batches, batch = batches.shape[0], batches.shape[1]
+
+    def body(carry, inp):
+        acc, s1, s2 = carry
+        i, xb = inp
+        log_w, aux = model.log_weights_and_aux(
+            params, cfg, jax.random.fold_in(key, i), xb, k)
+        w = weight_diagnostics(log_w)
+        kl = jnp.mean(aux["log_q"] - aux["log_prior"])
+        acc = acc + jnp.stack([w["diag/ess"], w["diag/log_weight_var"], kl])
+        means = [jnp.mean(h, axis=0) for h in aux["h"]]   # [B, d_l] per layer
+        s1 = tuple(s + jnp.sum(m, axis=0) for s, m in zip(s1, means))
+        s2 = tuple(s + jnp.sum(m * m, axis=0) for s, m in zip(s2, means))
+        return (acc, s1, s2), None
+
+    init = (jnp.zeros(3),
+            tuple(jnp.zeros(d) for d in cfg.n_latent_enc),
+            tuple(jnp.zeros(d) for d in cfg.n_latent_enc))
+    (acc, s1, s2), _ = lax.scan(body, init,
+                                (jnp.arange(n_batches), batches))
+    acc = acc / n_batches
+    n = n_batches * batch
+    active = jnp.zeros((), jnp.float32)
+    for s, q in zip(s1, s2):
+        var = jnp.maximum(q / n - (s / n) ** 2, 0.0)
+        active = active + jnp.sum(var > diag.active_threshold)
+    total_units = sum(cfg.n_latent_enc)
+    return {
+        "diag/ess": acc[0],
+        "diag/ess_frac": acc[0] / k,
+        "diag/log_weight_var": acc[1],
+        "diag/kl_q_p": acc[2],
+        "diag/active_units": active,
+        "diag/active_frac": active / total_units,
+    }

@@ -1,0 +1,130 @@
+"""Registry exporters beyond the JSONL/TensorBoard pair.
+
+The JSONL and TensorBoard surfaces already exist —
+:class:`~..utils.logging.MetricsLogger` (and its ``log_registry``) stamps
+``MetricRegistry.rows()`` through the same pipeline the experiment driver's
+per-stage rows ride. This module adds the pull-based surface:
+
+* :func:`prometheus_text` — the registry as a Prometheus text-format page
+  (counters as ``*_total``, gauges, histograms as summaries with quantile
+  labels);
+* :func:`start_metrics_server` — a daemon-thread HTTP endpoint serving that
+  page at ``/metrics``, which the ``iwae-serve`` CLI exposes via
+  ``--metrics-port``.
+
+Dependency-free (stdlib http.server); the server snapshots the registry per
+request, so a long-lived scrape always sees current values.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+from iwae_replication_project_tpu.telemetry.registry import MetricRegistry
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+#: summary-key -> prometheus quantile label (accepts unit-suffixed variants
+#: like ``p50_s`` from the serving latency histograms)
+_QUANTILES = (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99"))
+
+
+def _sanitize(name: str) -> str:
+    n = _NAME_RE.sub("_", name)
+    return n if not n[:1].isdigit() else "_" + n
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def prometheus_text(registries, namespace: str = "iwae") -> str:
+    """Render one or more registries as a Prometheus exposition page.
+
+    Later registries win on (sanitized) name collisions — pass the
+    process-default registry first and subsystem registries after it.
+    """
+    if isinstance(registries, MetricRegistry):
+        registries = (registries,)
+    counters, gauges, hists = {}, {}, {}
+    for reg in registries:
+        snap = reg.snapshot()
+        counters.update(snap["counters"])
+        gauges.update(snap["gauges"])
+        hists.update(snap["histograms"])
+
+    lines = []
+    for name, v in sorted(counters.items()):
+        m = f"{namespace}_{_sanitize(name)}_total"
+        lines += [f"# TYPE {m} counter", f"{m} {_fmt(v)}"]
+    for name, v in sorted(gauges.items()):
+        m = f"{namespace}_{_sanitize(name)}"
+        lines += [f"# TYPE {m} gauge", f"{m} {_fmt(v)}"]
+    for name, s in sorted(hists.items()):
+        m = f"{namespace}_{_sanitize(name)}"
+        lines.append(f"# TYPE {m} summary")
+        for key, label in _QUANTILES:
+            v = next((s[k] for k in (key, key + "_s") if s.get(k) is not None),
+                     None)
+            if v is not None:
+                lines.append(f'{m}{{quantile="{label}"}} {_fmt(v)}')
+        count = s.get("count") or 0
+        mean = next((s[k] for k in ("mean", "mean_s")
+                     if s.get(k) is not None), None)
+        lines.append(f"{m}_count {_fmt(count)}")
+        if mean is not None:
+            lines.append(f"{m}_sum {_fmt(mean * count)}")
+    return "\n".join(lines) + "\n"
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    registries: Sequence[MetricRegistry] = ()
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        if self.path.split("?")[0] not in ("/", "/metrics"):
+            self.send_error(404)
+            return
+        body = prometheus_text(self.registries).encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # scrapes must not spam the serving stdout
+        pass
+
+
+class _MetricsServer(ThreadingHTTPServer):
+    def shutdown(self):
+        """Stop serving AND close the listening socket — the stock
+        ThreadingHTTPServer leaves the socket bound after shutdown(), which
+        leaks the fd and EADDRINUSEs the next fixed-port start."""
+        super().shutdown()
+        self.server_close()
+
+
+def start_metrics_server(registries, port: int,
+                         host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    """Serve ``/metrics`` in a daemon thread; returns the live server
+    (``.server_address[1]`` is the bound port — pass ``port=0`` for an
+    ephemeral one; ``.shutdown()`` stops it and releases the port)."""
+    if isinstance(registries, MetricRegistry):
+        registries = (registries,)
+
+    class Handler(_MetricsHandler):
+        pass
+
+    Handler.registries = tuple(registries)
+    srv = _MetricsServer((host, port), Handler)
+    threading.Thread(target=srv.serve_forever, name="iwae-metrics-http",
+                     daemon=True).start()
+    return srv

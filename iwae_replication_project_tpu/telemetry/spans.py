@@ -1,0 +1,101 @@
+"""Hierarchical span tracing: one name, three sinks.
+
+``with span("train/stage"):`` nests (a thread-local stack joins names into a
+path like ``train/stage/aot/epoch``), and on exit the wall time lands in
+
+* the metric registry — histogram ``span/<path>`` (p50/p95/p99 + count),
+  exported with everything else (JSONL rows, TensorBoard, Prometheus);
+* ``jax.profiler.TraceAnnotation(<path>)`` — the SAME names appear on the
+  host timeline of an XLA profiler trace (TensorBoard profile tab), so a
+  registry percentile can be cross-checked against the trace event it
+  summarizes.
+
+Spans are host-side: around dispatches, stages, request handling. They do
+not (cannot) reach inside a jitted program — device-side attribution comes
+from the stable program names the framework already bakes into its XLA
+modules (``epoch_IWAE_k50`` etc., training/epoch.py).
+
+jax is imported lazily so importing this module (e.g. from
+utils/compile_cache.py, which entry points import before configuring jax's
+platform) does not initialize jax backends.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Iterator, Optional
+
+from iwae_replication_project_tpu.telemetry.registry import (
+    MetricRegistry,
+    get_registry,
+)
+
+_tls = threading.local()
+_trace_annotation_cls = None  # resolved lazily; False = unavailable
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_span() -> Optional[str]:
+    """The innermost active span's full path on this thread, or None."""
+    st = _stack()
+    return st[-1] if st else None
+
+
+def _annotation(path: str):
+    global _trace_annotation_cls
+    if _trace_annotation_cls is None:
+        try:
+            import jax
+            _trace_annotation_cls = jax.profiler.TraceAnnotation
+        except Exception:  # jax absent/too old: spans still time + register
+            _trace_annotation_cls = False
+    if _trace_annotation_cls is False:
+        return contextlib.nullcontext()
+    return _trace_annotation_cls(path)
+
+
+@contextlib.contextmanager
+def span(name: str, registry: Optional[MetricRegistry] = None) -> Iterator[str]:
+    """Time a host-side section under `name`, nested inside any active span.
+
+    Yields the full path. Exceptions propagate; the span still records (a
+    failing dispatch's latency is exactly the one worth seeing).
+    """
+    reg = registry if registry is not None else get_registry()
+    st = _stack()
+    path = f"{st[-1]}/{name}" if st else name
+    st.append(path)
+    t0 = time.perf_counter()
+    try:
+        with _annotation(path):
+            yield path
+    finally:
+        st.pop()
+        reg.histogram(f"span/{path}").record(time.perf_counter() - t0)
+
+
+def spanned(fn, name: str, registry: Optional[MetricRegistry] = None):
+    """Wrap a callable so every invocation runs under ``span(name)``.
+
+    AOT-compatible: the wrapper re-exposes the wrappee's ``.lower`` (what
+    :func:`~..utils.compile_cache.aot_call` uses to build executables), so a
+    span-wrapped jitted function still routes through the warm-path registry.
+    """
+    def call(*args, **kwargs):
+        with span(name, registry=registry):
+            return fn(*args, **kwargs)
+
+    call.__name__ = getattr(fn, "__name__", name)
+    call.__qualname__ = getattr(fn, "__qualname__", name)
+    if hasattr(fn, "lower"):
+        call.lower = fn.lower
+    call.__wrapped__ = fn
+    return call

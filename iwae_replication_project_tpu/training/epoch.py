@@ -10,6 +10,13 @@ This also moves the data pipeline's randomness on-device: the permutation and
 the Bernoulli re-binarization draw from the same threaded PRNG key as the
 model noise, so an epoch is a pure function `(state, x_train, epoch_idx) ->
 (state, losses)` — reproducible, checkpointable, and shardable.
+
+With a :class:`~..telemetry.diagnostics.DiagnosticsConfig` the scan
+additionally accumulates the first/second gradient moments of the trailing
+``snr_window`` steps and returns Rainforth-style gradient-SNR scalars next
+to the losses — still one dispatch, zero extra host syncs (the driver
+fetches them with its per-stage fetch). Off (the default), the compiled
+program is byte-identical to the pre-diagnostics one.
 """
 
 from __future__ import annotations
@@ -23,6 +30,13 @@ from jax import lax
 
 from iwae_replication_project_tpu.models import iwae as model
 from iwae_replication_project_tpu.objectives import ObjectiveSpec, objective_value_and_grad
+from iwae_replication_project_tpu.telemetry.diagnostics import (
+    DiagnosticsConfig,
+    grad_accum_init,
+    grad_accum_update,
+    grad_snr_summary,
+)
+from iwae_replication_project_tpu.telemetry.spans import spanned
 from iwae_replication_project_tpu.training.train_step import TrainState, make_adam
 
 
@@ -30,7 +44,8 @@ def make_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, n_train: int,
                   batch_size: int, stochastic_binarization: bool = False,
                   optimizer: optax.GradientTransformation | None = None,
                   shuffle: bool = True, donate: bool = True,
-                  epochs_per_call: int = 1
+                  epochs_per_call: int = 1,
+                  diagnostics: Optional[DiagnosticsConfig] = None
                   ) -> Callable[[TrainState, jax.Array], Tuple[TrainState, jax.Array]]:
     """Build ``epoch(state, x_train) -> (state, per-batch losses)``, jitted.
 
@@ -43,6 +58,11 @@ def make_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, n_train: int,
     costs ~10-15 ms, so at small-dataset scale (e.g. digits: ~5 ms of device
     work per pass) per-pass dispatch dominates the stage loop — the
     experiment driver batches the long late stages with this knob.
+
+    With `diagnostics` enabled the second return value becomes
+    ``(losses, {"diag/grad_snr*": scalars})`` — SNR moments accumulated over
+    the trailing ``min(snr_window, n_batches)`` steps of each epoch (the
+    last epoch's, under ``epochs_per_call > 1``).
     """
     opt = optimizer if optimizer is not None else make_adam()
     n_batches = n_train // batch_size
@@ -50,6 +70,8 @@ def make_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, n_train: int,
         raise ValueError(f"batch_size={batch_size} exceeds n_train={n_train}")
     if epochs_per_call < 1:
         raise ValueError(f"epochs_per_call={epochs_per_call} must be >= 1")
+    diag_on = diagnostics is not None and diagnostics.enabled
+    window = min(diagnostics.snr_window, n_batches) if diag_on else 0
 
     def epoch(state: TrainState, x_train: jax.Array):
         # four independent streams: the carried key is never itself consumed
@@ -62,8 +84,7 @@ def make_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, n_train: int,
             perm = jnp.arange(n_train)
         idx = perm[: n_batches * batch_size].reshape(n_batches, batch_size)
 
-        def body(st, xs):
-            batch_idx, i = xs
+        def step(st, batch_idx, i):
             batch = x_train[batch_idx]
             if stochastic_binarization:
                 batch = jax.random.bernoulli(
@@ -73,23 +94,48 @@ def make_epoch_fn(spec: ObjectiveSpec, cfg: model.ModelConfig, n_train: int,
             neg = jax.tree.map(jnp.negative, grads)
             updates, opt_state = opt.update(neg, st.opt_state, st.params)
             params = optax.apply_updates(st.params, updates)
-            return TrainState(params, opt_state, st.key, st.step + 1), -bound
+            return (TrainState(params, opt_state, st.key, st.step + 1),
+                    -bound, grads)
 
-        state, losses = lax.scan(body, state, (idx, jnp.arange(n_batches)))
-        return state._replace(key=key_next), losses
+        if not diag_on:
+            def body(st, xs):
+                st, loss, _ = step(st, *xs)
+                return st, loss
+
+            state, losses = lax.scan(body, state, (idx, jnp.arange(n_batches)))
+            return state._replace(key=key_next), losses
+
+        def body(carry, xs):
+            st, acc = carry
+            st, loss, grads = step(st, *xs)
+            include = (xs[1] >= n_batches - window).astype(jnp.float32)
+            return (st, grad_accum_update(acc, grads, include)), loss
+
+        (state, (s1, s2)), losses = lax.scan(
+            body, (state, grad_accum_init(state.params)),
+            (idx, jnp.arange(n_batches)))
+        return (state._replace(key=key_next),
+                (losses, grad_snr_summary(s1, s2, window)))
 
     # stable, descriptive program names: they become the XLA module names, so
     # persistent-compilation-cache entries (`jit_epoch_IWAE_k50-<hash>`) and
     # profiler traces are attributable to the objective that compiled them
     if epochs_per_call == 1:
         epoch.__name__ = epoch.__qualname__ = f"epoch_{spec.name}_k{spec.k}"
-        return jax.jit(epoch, donate_argnums=(0,) if donate else ())
+        return spanned(jax.jit(epoch, donate_argnums=(0,) if donate else ()),
+                       "train/epoch")
 
     def multi(state: TrainState, x_train: jax.Array):
-        state, losses = lax.scan(lambda st, _: epoch(st, x_train), state,
-                                 None, length=epochs_per_call)
-        return state, losses.reshape(-1)
+        state, out = lax.scan(lambda st, _: epoch(st, x_train), state,
+                              None, length=epochs_per_call)
+        if not diag_on:
+            return state, out.reshape(-1)
+        losses, diag = out
+        # SNR moments from the LAST epoch of the block: the freshest window
+        return state, (losses.reshape(-1),
+                       jax.tree.map(lambda a: a[-1], diag))
 
     multi.__name__ = multi.__qualname__ = \
         f"epoch_block{epochs_per_call}_{spec.name}_k{spec.k}"
-    return jax.jit(multi, donate_argnums=(0,) if donate else ())
+    return spanned(jax.jit(multi, donate_argnums=(0,) if donate else ()),
+                   "train/epoch_block")

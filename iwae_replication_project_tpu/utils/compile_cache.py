@@ -274,7 +274,13 @@ def aot_call(name: str, jitted_fn: Callable, args: Tuple = (),
     kwargs = kwargs or {}
     exe = _registry_get_or_compile(name, jitted_fn, args, kwargs,
                                    static_kwargs, build_key, count_hit=True)
-    return exe(*args, **kwargs)
+    # every AOT dispatch in the process funnels through here — the ONE span
+    # site that covers training epochs, the fused eval suite, and serving
+    # alike (the time recorded is enqueue, not device completion: async
+    # dispatch returns as soon as the transfer program is queued)
+    from iwae_replication_project_tpu.telemetry.spans import span
+    with span(f"aot/{name}"):
+        return exe(*args, **kwargs)
 
 
 def aot_warm(name: str, jitted_fn: Callable, args: Tuple = (),

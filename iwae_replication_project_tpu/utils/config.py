@@ -155,6 +155,14 @@ class ExperimentConfig:
     donate_buffers: bool = True
 
     # observability / persistence
+    # on-device estimator diagnostics (telemetry/diagnostics.py): ESS /
+    # log-weight variance / KL / active units per eval, gradient SNR over the
+    # trailing snr_window train steps. Pure in-graph reductions — zero extra
+    # host syncs; --no-diagnostics restores the byte-identical pre-telemetry
+    # programs (bench.py --telemetry pins the off-mode as free). Execution
+    # knob, not a science field (does not change run_name()).
+    diagnostics: bool = True
+    snr_window: int = 50
     save_figures: bool = True  # per-stage sample/reconstruction PNG grids
     log_dir: str = "runs"
     checkpoint_dir: str = "checkpoints"
@@ -183,6 +191,16 @@ class ExperimentConfig:
             compute_dtype=self.compute_dtype,
             fused_likelihood=bool(fused),
         )
+
+    def diagnostics_config(self):
+        """The telemetry DiagnosticsConfig this run trains/evals under, or
+        None when diagnostics are off (the gate every jitted call site keys
+        its program variant on)."""
+        if not self.diagnostics:
+            return None
+        from iwae_replication_project_tpu.telemetry.diagnostics import (
+            DiagnosticsConfig)
+        return DiagnosticsConfig(enabled=True, snr_window=self.snr_window)
 
     def objective_spec(self, stage: Optional[int] = None) -> ObjectiveSpec:
         """The objective in effect at `stage` (1-based; None -> the base one)."""
@@ -292,6 +310,13 @@ def build_argparser() -> argparse.ArgumentParser:
                     action="store_false", default=None,
                     help="disable train-state buffer donation in the staged "
                          "driver (the pre-warm-path behavior)")
+    ap.add_argument("--no-diagnostics", dest="diagnostics",
+                    action="store_false", default=None,
+                    help="disable the on-device estimator diagnostics "
+                         "(ESS / log-weight variance / grad SNR) — restores "
+                         "the byte-identical pre-telemetry programs")
+    ap.add_argument("--snr-window", dest="snr_window", default=None, type=int,
+                    help="trailing train steps in the gradient-SNR estimate")
     ap.add_argument("--no-resume", dest="resume", action="store_false", default=None)
     ap.add_argument("--no-figures", dest="save_figures", action="store_false",
                     default=None)

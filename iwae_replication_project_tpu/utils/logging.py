@@ -119,29 +119,55 @@ class TensorBoardWriter:
 
 class MetricsLogger:
     """JSONL + TensorBoard scalar logging with the reference's 7-scalar schema
-    (flexible_IWAE.py:539-545) plus anything else handed to :meth:`log`."""
+    (flexible_IWAE.py:539-545) plus anything else handed to :meth:`log`.
+
+    ``flush_every`` is the disk-sync cadence in rows: the default (1) keeps
+    the historical flush-per-row behavior the staged driver's 8 rows/run
+    never noticed, while high-frequency telemetry export (per-step rows,
+    serving snapshots) sets it higher so each ``log`` call does not pay two
+    fsync-ish flushes; :meth:`close` (and :meth:`flush`) always drain, so no
+    cadence loses rows on an orderly shutdown.
+    """
 
     def __init__(self, logdir: str, run_name: str = "run",
-                 tensorboard: bool = True):
+                 tensorboard: bool = True, flush_every: int = 1):
+        if flush_every < 1:
+            raise ValueError(f"flush_every must be >= 1, got {flush_every}")
         self.dir = os.path.join(logdir, run_name)
         os.makedirs(self.dir, exist_ok=True)
         self._jsonl = open(os.path.join(self.dir, "metrics.jsonl"), "a")
         self._tb = TensorBoardWriter(self.dir) if tensorboard else None
+        self.flush_every = flush_every
+        self._since_flush = 0
 
     def log(self, metrics: Dict[str, float], step: int):
         rec = {"step": int(step), "time": time.time()}
         rec.update({k: float(v) for k, v in metrics.items()
                     if isinstance(v, (int, float)) or hasattr(v, "item")})
         self._jsonl.write(json.dumps(rec) + "\n")
-        self._jsonl.flush()
         if self._tb is not None:
             for k, v in rec.items():
                 if k in ("step", "time"):
                     continue
                 self._tb.scalar(k, v, step)
+        self._since_flush += 1
+        if self._since_flush >= self.flush_every:
+            self.flush()
+
+    def log_registry(self, registry, step: int, prefix: str = ""):
+        """Stamp a telemetry-registry snapshot (telemetry/registry.py) as one
+        flat row: counters/gauges verbatim, histograms as ``name/stat`` —
+        the registry's JSONL/TensorBoard exporter."""
+        self.log(registry.rows(prefix=prefix), step=step)
+
+    def flush(self):
+        self._jsonl.flush()
+        if self._tb is not None:
             self._tb.flush()
+        self._since_flush = 0
 
     def close(self):
+        self.flush()
         self._jsonl.close()
         if self._tb is not None:
             self._tb.close()

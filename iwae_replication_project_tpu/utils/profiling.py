@@ -19,10 +19,12 @@ from __future__ import annotations
 
 import contextlib
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 import chex
 import jax
+
+from iwae_replication_project_tpu.telemetry.registry import Histogram
 
 
 @contextlib.contextmanager
@@ -58,11 +60,18 @@ def assert_finite_tree(tree, label: str = "tree") -> None:
 
 
 class StepTimer:
-    """Wall-clock timing for repeated steps; cheap enough to leave on."""
+    """Wall-clock timing for repeated steps; cheap enough to leave on.
+
+    A context-manager view over the telemetry registry's log-spaced
+    :class:`~..telemetry.registry.Histogram` (the tree's one
+    histogram/percentile implementation): O(1) per step at any count, same
+    ~one-bin quantile resolution as the serving latency and span metrics,
+    exact max. Same summary schema as before the telemetry layer.
+    """
 
     def __init__(self, sync_fn=None):
         self._sync = sync_fn
-        self._durations: List[float] = []
+        self._hist = Histogram()
         self._t0: Optional[float] = None
 
     def __enter__(self):
@@ -72,27 +81,26 @@ class StepTimer:
     def __exit__(self, *exc):
         if self._sync is not None:
             self._sync()
-        self._durations.append(time.perf_counter() - self._t0)
+        self._hist.record(time.perf_counter() - self._t0)
         self._t0 = None
         return False
 
     @property
     def count(self) -> int:
-        return len(self._durations)
+        return self._hist.n
 
     def summary(self) -> Dict[str, float]:
-        if not self._durations:
+        s = self._hist.summary()
+        if not s["count"]:
             return {"count": 0}
-        d = sorted(self._durations)
-        n = len(d)
         return {
-            "count": n,
-            "total_s": sum(d),
-            "mean_s": sum(d) / n,
-            "p50_s": d[n // 2],
-            "p95_s": d[min(n - 1, int(n * 0.95))],
-            "max_s": d[-1],
+            "count": s["count"],
+            "total_s": self._hist.total,
+            "mean_s": s["mean"],
+            "p50_s": s["p50"],
+            "p95_s": s["p95"],
+            "max_s": s["max"],
         }
 
     def reset(self):
-        self._durations.clear()
+        self._hist = Histogram()

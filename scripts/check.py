@@ -5,7 +5,10 @@
 1. **iwaelint** over the production tree (``[tool.iwaelint]`` paths) — the
    8-rule JAX correctness suite (analysis/), including the ``cache-setup``
    guard on every entry point (the ``iwae-serve`` CLI among them);
-2. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with ``--sanitize``
+2. **telemetry smoke** (scripts/telemetry_smoke.py) — registry export,
+   span nesting, jitted ESS identities, and all three exporter surfaces
+   (JSONL/TB, Prometheus text, /metrics HTTP) under ``JAX_PLATFORMS=cpu``;
+3. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with ``--sanitize``
    armed, so the marked subset additionally runs under
    ``jax.transfer_guard("disallow")`` + ``jax.debug_nans``. The serving
    subsystem's fast tests (tests/test_serving.py: batcher policy,
@@ -37,6 +40,15 @@ def run_lint() -> int:
         cwd=REPO)
 
 
+def run_telemetry_smoke() -> int:
+    print("== telemetry smoke: registry export + span nesting ".ljust(72, "="))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.call(
+        [sys.executable, os.path.join("scripts", "telemetry_smoke.py")],
+        cwd=REPO, env=env)
+
+
 def run_tests(extra) -> int:
     print("== pytest: tier-1 (fast profile) + sanitizers ".ljust(72, "="))
     env = dict(os.environ)
@@ -58,15 +70,21 @@ def main(argv=None) -> int:
     ap.add_argument("--tests-only", action="store_true")
     args = ap.parse_args(argv)
 
+    single_stage = args.lint_only or args.tests_only
     rc_lint = 0 if args.tests_only else run_lint()
+    # the smoke stage rides the full gate only: --lint-only / --tests-only
+    # keep their single-stage contract
+    rc_smoke = 0 if single_stage else run_telemetry_smoke()
     rc_tests = 0 if args.lint_only else run_tests(passthrough)
 
     print("== check summary ".ljust(72, "="))
     if not args.tests_only:
         print(f"lint : {'ok' if rc_lint == 0 else f'FAILED (rc={rc_lint})'}")
+    if not single_stage:
+        print(f"smoke: {'ok' if rc_smoke == 0 else f'FAILED (rc={rc_smoke})'}")
     if not args.lint_only:
         print(f"tests: {'ok' if rc_tests == 0 else f'FAILED (rc={rc_tests})'}")
-    return 1 if (rc_lint or rc_tests) else 0
+    return 1 if (rc_lint or rc_smoke or rc_tests) else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,108 @@
+"""Telemetry smoke stage for scripts/check.py: registry export + span nesting.
+
+Exercises, in one short CPU process (``JAX_PLATFORMS=cpu``):
+
+1. registry instruments (counter/gauge/histogram) and their snapshot/rows;
+2. nested spans — the full path must appear as a ``span/...`` histogram;
+3. a jitted on-device diagnostic (ESS of synthetic log-weights) — both the
+   uniform-weights and the one-sample-dominates identities;
+4. the three exporters: JSONL + TensorBoard via MetricsLogger (flush_every
+   policy + registry export), Prometheus text, and the /metrics HTTP
+   endpoint.
+
+Exit 0 on success, 1 with a message on the first failed check. Kept
+assert-light on timing (CI hosts are noisy); structure is what's checked.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        setup_persistent_cache)
+
+    # warm path discipline, like every entry point: the jitted ESS probe
+    # below should not recompile on repeated CI runs
+    setup_persistent_cache(base_dir=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+    import numpy as np
+
+    from iwae_replication_project_tpu.telemetry import (
+        MetricRegistry, prometheus_text, span, start_metrics_server)
+    from iwae_replication_project_tpu.telemetry.diagnostics import ess
+    from iwae_replication_project_tpu.utils.logging import MetricsLogger
+
+    reg = MetricRegistry()
+
+    # 1) instruments
+    reg.counter("requests").inc(3)
+    reg.gauge("depth").set(2.0)
+    for v in (0.001, 0.002, 0.004):
+        reg.histogram("lat").record(v)
+    snap = reg.snapshot()
+    assert snap["counters"]["requests"] == 3, snap
+    assert snap["gauges"]["depth"] == 2.0, snap
+    assert snap["histograms"]["lat"]["count"] == 3, snap
+
+    # 2) nested spans -> one histogram per full path
+    with span("smoke/outer", registry=reg):
+        with span("inner", registry=reg):
+            pass
+    rows = reg.rows()
+    assert "span/smoke/outer/count" in rows, sorted(rows)
+    assert "span/smoke/outer/inner/count" in rows, sorted(rows)
+
+    # 3) jitted ESS identities: uniform weights -> k; degenerate -> ~1
+    k = 8
+    uniform = jax.numpy.zeros((k, 4))
+    degenerate = jax.numpy.concatenate(
+        [jax.numpy.full((1, 4), 50.0), jax.numpy.zeros((k - 1, 4))])
+    e_u, e_d = jax.jit(lambda a, b: (ess(a), ess(b)))(uniform, degenerate)
+    assert np.allclose(np.asarray(e_u), k), e_u
+    assert np.allclose(np.asarray(e_d), 1.0, atol=1e-3), e_d
+
+    # 4) exporters
+    with tempfile.TemporaryDirectory() as tmp:
+        logger = MetricsLogger(tmp, run_name="smoke", flush_every=100)
+        logger.log({"a": 1.0}, step=1)
+        logger.log_registry(reg, step=2)
+        logger.close()  # flush-on-close must drain the buffered rows
+        lines = open(os.path.join(tmp, "smoke", "metrics.jsonl")).read() \
+            .strip().splitlines()
+        assert len(lines) == 2, lines
+        assert json.loads(lines[1])["span/smoke/outer/count"] == 1.0
+        assert any(f.startswith("events.out.tfevents.")
+                   for f in os.listdir(os.path.join(tmp, "smoke")))
+
+    page = prometheus_text(reg)
+    assert "iwae_requests_total 3" in page, page
+    assert 'iwae_span_smoke_outer_inner{quantile="0.5"}' in page, page
+
+    srv = start_metrics_server(reg, port=0)
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/metrics"
+        body = urllib.request.urlopen(url, timeout=10).read().decode()
+        assert "iwae_depth 2.0" in body, body
+    finally:
+        srv.shutdown()
+
+    print("telemetry smoke: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"telemetry smoke FAILED: {e}")
+        sys.exit(1)

@@ -145,6 +145,10 @@ def _tiny_cfg(tmp_path, tag, **over):
         loss_function="IWAE", k=4, batch_size=32, n_stages=2,
         eval_k=4, nll_k=8, nll_chunk=4, eval_batch_size=16,
         activity_samples=8, save_figures=False,
+        # these tests pin the warm-path program COUNTS; diagnostics add one
+        # estimator-diagnostics program per eval (its own aot entry), so they
+        # run the pre-telemetry profile the counts were pinned under
+        diagnostics=False,
         log_dir=str(tmp_path / f"runs_{tag}"),
         checkpoint_dir=str(tmp_path / f"ckpt_{tag}"),
     )

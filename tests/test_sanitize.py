@@ -115,6 +115,34 @@ def test_fused_eval_suite_under_guard(dev):
     assert np.isfinite(out).all()
 
 
+def test_epoch_with_diagnostics_under_guard(dev):
+    """The diagnostics-enabled epoch program: the telemetry layer's central
+    claim is that grad-SNR accumulation adds device reductions and ZERO host
+    syncs — the transfer guard is the proof."""
+    from iwae_replication_project_tpu.telemetry.diagnostics import (
+        DiagnosticsConfig)
+    fn = make_epoch_fn(dev["spec"], dev["cfg"], N, B, optimizer=dev["opt"],
+                       donate=False,
+                       diagnostics=DiagnosticsConfig(snr_window=2))
+    state, (losses, diag) = fn(dev["state"], dev["x"])
+    assert np.isfinite(np.asarray(losses)).all()
+    for k, v in diag.items():
+        assert np.isfinite(np.asarray(v)), k
+
+
+def test_estimator_diagnostics_under_guard(dev):
+    """The per-eval weight-space diagnostics program (ESS / log-weight
+    variance / KL / active units) under transfer guard — same zero-host-sync
+    contract as the fused eval suite it rides next to."""
+    from iwae_replication_project_tpu.telemetry.diagnostics import (
+        DiagnosticsConfig, estimator_diagnostics)
+    out = estimator_diagnostics(dev["state"].params, dev["cfg"],
+                                dev["key_eval"], dev["batches"], 4,
+                                DiagnosticsConfig())
+    for k, v in out.items():
+        assert np.isfinite(np.asarray(v)), k
+
+
 @pytest.fixture(scope="module")
 def serve_eng(dev):
     """A warmed serving engine (setup outside the guard: construction commits

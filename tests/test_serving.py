@@ -417,7 +417,8 @@ def test_cli_synthetic_load_sweep(tmp_path):
         [sys.executable, "-m", "iwae_replication_project_tpu.serving",
          "--preset", "digits-vae-1l-k1", "--ops", "score",
          "--max-batch", "8", "--requests", "24", "--sizes", "1,3,7,2",
-         "--timeout-s", "30", "--log-dir", str(tmp_path / "runs")],
+         "--timeout-s", "30", "--log-dir", str(tmp_path / "runs"),
+         "--metrics-port", "0"],
         capture_output=True, text=True, timeout=600,
         env={**__import__("os").environ, "JAX_PLATFORMS": "cpu",
              "IWAE_COMPILE_CACHE": str(tmp_path / "cache")})
@@ -427,6 +428,7 @@ def test_cli_synthetic_load_sweep(tmp_path):
     warm = next(ln for ln in lines if "warmup" in ln)
     snap = next(ln for ln in lines if "counters" in ln)
     assert warm["warmup"]["programs"] == 4  # score x ladder(1,2,4,8)
+    assert warm["metrics_port"] > 0  # the Prometheus endpoint bound a port
     c = snap["counters"]
     assert c["completed"] == c["submitted"] > 0
     assert c["aot_misses"] == 0 and c["recompiles"] == 0

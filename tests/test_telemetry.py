@@ -1,0 +1,432 @@
+"""Telemetry layer tests: registry, spans, exporters, on-device diagnostics,
+and the driver integration (the digits smoke of the acceptance criteria).
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu.models import iwae as model
+from iwae_replication_project_tpu.objectives import ObjectiveSpec
+from iwae_replication_project_tpu.telemetry import (
+    MetricRegistry,
+    current_span,
+    get_registry,
+    prometheus_text,
+    span,
+    start_metrics_server,
+)
+from iwae_replication_project_tpu.telemetry.diagnostics import (
+    DiagnosticsConfig,
+    ess,
+    estimator_diagnostics,
+    weight_diagnostics,
+)
+
+CFG = model.ModelConfig(n_hidden_enc=(16, 8), n_latent_enc=(8, 4),
+                        n_hidden_dec=(8, 16), n_latent_dec=(8, 784))
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_gauge_histogram(self):
+        reg = MetricRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(2)
+        reg.gauge("g").set(7.5)
+        for v in (0.01, 0.02, 0.04):
+            reg.histogram("h").record(v)
+        snap = reg.snapshot()
+        assert snap["counters"]["c"] == 3
+        assert snap["gauges"]["g"] == 7.5
+        s = snap["histograms"]["h"]
+        assert s["count"] == 3
+        assert s["mean"] == pytest.approx(0.07 / 3)
+        assert s["p50"] is not None and s["p99"] >= s["p50"]
+
+    def test_get_or_create_returns_same_instrument(self):
+        reg = MetricRegistry()
+        assert reg.counter("x") is reg.counter("x")
+        assert reg.histogram("h") is reg.histogram("h")
+
+    def test_type_conflict_rejected(self):
+        reg = MetricRegistry()
+        reg.counter("name")
+        with pytest.raises(ValueError, match="different instrument type"):
+            reg.gauge("name")
+
+    def test_rows_flat_and_numeric(self):
+        reg = MetricRegistry()
+        reg.counter("c").inc(5)
+        reg.gauge("g").set(1.0)
+        reg.histogram("lat/h").record(0.01)
+        rows = reg.rows(prefix="p/")
+        assert rows["p/c"] == 5.0
+        assert rows["p/lat/h/count"] == 1.0
+        assert all(isinstance(v, float) for v in rows.values())
+
+    def test_empty_histogram_percentile_none(self):
+        reg = MetricRegistry()
+        h = reg.histogram("h")
+        assert h.percentile(0.5) is None
+        assert h.summary()["p99"] is None
+        assert "h/p99" not in reg.rows()  # None stats dropped from rows
+
+    def test_thread_safety_counts_every_increment(self):
+        reg = MetricRegistry()
+
+        def work():
+            for _ in range(1000):
+                reg.counter("n").inc()
+                reg.histogram("h").record(0.001)
+
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert reg.counter("n").value == 4000
+        assert reg.histogram("h").summary()["count"] == 4000
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+class TestSpans:
+    def test_nesting_builds_paths(self):
+        reg = MetricRegistry()
+        with span("a", registry=reg) as outer:
+            assert outer == "a" == current_span()
+            with span("b/c", registry=reg) as inner:
+                assert inner == "a/b/c" == current_span()
+        assert current_span() is None
+        rows = reg.rows()
+        assert rows["span/a/count"] == 1.0
+        assert rows["span/a/b/c/count"] == 1.0
+        # parent wall time includes the child's
+        assert reg.histogram("span/a").total >= \
+            reg.histogram("span/a/b/c").total
+
+    def test_exception_still_records_and_unwinds(self):
+        reg = MetricRegistry()
+        with pytest.raises(RuntimeError):
+            with span("boom", registry=reg):
+                raise RuntimeError("x")
+        assert current_span() is None
+        assert reg.histogram("span/boom").summary()["count"] == 1
+
+    def test_default_registry_is_process_wide(self):
+        with span("telemetry-test/default"):
+            pass
+        assert get_registry().histogram(
+            "span/telemetry-test/default").summary()["count"] >= 1
+
+    def test_thread_local_stacks_do_not_interleave(self):
+        reg = MetricRegistry()
+        seen = {}
+
+        def work(name):
+            with span(name, registry=reg):
+                seen[name] = current_span()
+
+        threads = [threading.Thread(target=work, args=(f"t{i}",))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert seen == {"t0": "t0", "t1": "t1", "t2": "t2"}
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+class TestExporters:
+    def test_prometheus_text_shapes(self):
+        reg = MetricRegistry()
+        reg.counter("submitted").inc(3)
+        reg.gauge("queue_depth").set(2)
+        for v in (0.001, 0.002, 0.004):
+            reg.histogram("latency/score/b4").record(v)
+        page = prometheus_text(reg)
+        assert "# TYPE iwae_submitted_total counter" in page
+        assert "iwae_submitted_total 3" in page
+        assert "iwae_queue_depth 2" in page
+        assert 'iwae_latency_score_b4{quantile="0.99"}' in page
+        assert "iwae_latency_score_b4_count 3" in page
+        assert "iwae_latency_score_b4_sum" in page
+
+    def test_prometheus_merges_registries(self):
+        a, b = MetricRegistry(), MetricRegistry()
+        a.counter("only_a").inc()
+        b.counter("only_b").inc()
+        page = prometheus_text((a, b))
+        assert "iwae_only_a_total 1" in page and "iwae_only_b_total 1" in page
+
+    def test_http_metrics_endpoint(self):
+        reg = MetricRegistry()
+        reg.counter("hits").inc(9)
+        srv = start_metrics_server(reg, port=0)
+        try:
+            port = srv.server_address[1]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+            assert "iwae_hits_total 9" in body
+            reg.counter("hits").inc()  # a later scrape sees fresh values
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10).read().decode()
+            assert "iwae_hits_total 10" in body
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=10)
+        finally:
+            srv.shutdown()
+
+    def test_metrics_server_shutdown_releases_port(self):
+        """shutdown() must close the listening socket too — otherwise a
+        restart on the same fixed --metrics-port gets EADDRINUSE."""
+        reg = MetricRegistry()
+        srv = start_metrics_server(reg, port=0)
+        port = srv.server_address[1]
+        srv.shutdown()
+        srv2 = start_metrics_server(reg, port=port)  # rebind the same port
+        try:
+            assert srv2.server_address[1] == port
+        finally:
+            srv2.shutdown()
+
+    def test_serving_metrics_rides_the_registry(self):
+        """ServingMetrics is an adapter over MetricRegistry — its counters
+        and histograms must be visible to the Prometheus exporter without
+        any serving-specific code."""
+        from iwae_replication_project_tpu.serving.metrics import ServingMetrics
+        m = ServingMetrics()
+        m.count("submitted", 4)
+        m.record_latency("score", 4, 0.005)
+        page = prometheus_text(m.registry)
+        assert "iwae_submitted_total 4" in page
+        assert 'iwae_latency_score_b4{quantile="0.5"}' in page
+
+
+# ---------------------------------------------------------------------------
+# on-device diagnostics
+# ---------------------------------------------------------------------------
+
+class TestWeightDiagnostics:
+    def test_ess_uniform_weights_is_k(self, rng):
+        assert np.allclose(np.asarray(ess(jnp.zeros((8, 5)))), 8.0)
+
+    def test_ess_degenerate_weights_is_one(self):
+        lw = jnp.concatenate([jnp.full((1, 5), 60.0), jnp.zeros((7, 5))])
+        assert np.allclose(np.asarray(ess(lw)), 1.0, atol=1e-3)
+
+    def test_ess_shift_invariant(self, rng):
+        """ESS depends on the normalized weights only — adding a constant to
+        all log-weights (the max-stabilization the bound applies) must not
+        change it."""
+        lw = jax.random.normal(rng, (16, 6))
+        np.testing.assert_allclose(np.asarray(ess(lw)),
+                                   np.asarray(ess(lw + 123.0)), rtol=1e-5)
+
+    def test_ess_matches_direct_formula(self, rng):
+        lw = np.asarray(jax.random.normal(rng, (32, 4)), np.float64)
+        w = np.exp(lw - lw.max(0))
+        direct = w.sum(0) ** 2 / (w ** 2).sum(0)
+        np.testing.assert_allclose(np.asarray(ess(jnp.asarray(lw))), direct,
+                                   rtol=1e-4)
+
+    def test_weight_diagnostics_bundle(self, rng):
+        lw = jax.random.normal(rng, (8, 5)) * 2.0
+        d = weight_diagnostics(lw)
+        assert d["diag/ess_frac"] == pytest.approx(
+            float(d["diag/ess"]) / 8, rel=1e-6)
+        assert d["diag/log_weight_var"] == pytest.approx(
+            float(jnp.mean(jnp.var(lw, axis=0))), rel=1e-5)
+
+    def test_snr_window_validated(self):
+        """window < 1 would divide zero moments by zero -> silent NaN
+        diag/grad_snr* rows; it must refuse at construction."""
+        with pytest.raises(ValueError, match="snr_window"):
+            DiagnosticsConfig(snr_window=0)
+        with pytest.raises(ValueError, match="snr_window"):
+            from iwae_replication_project_tpu.utils.config import (
+                ExperimentConfig)
+            ExperimentConfig(snr_window=-1).diagnostics_config()
+
+    def test_estimator_diagnostics_program(self, rng):
+        params = model.init_params(rng, CFG)
+        batches = jnp.asarray(
+            (np.random.RandomState(0).rand(3, 8, 784) > 0.5)
+            .astype(np.float32))
+        out = estimator_diagnostics(params, CFG, jax.random.fold_in(rng, 1),
+                                    batches, 6, DiagnosticsConfig())
+        vals = {k: float(v) for k, v in out.items()}
+        assert set(vals) == {"diag/ess", "diag/ess_frac",
+                             "diag/log_weight_var", "diag/kl_q_p",
+                             "diag/active_units", "diag/active_frac"}
+        assert 1.0 <= vals["diag/ess"] <= 6.0
+        assert 0.0 <= vals["diag/active_units"] <= sum(CFG.n_latent_enc)
+        assert vals["diag/active_frac"] == pytest.approx(
+            vals["diag/active_units"] / sum(CFG.n_latent_enc))
+        assert all(np.isfinite(v) for v in vals.values())
+
+
+class TestEpochDiagnostics:
+    def _setup(self):
+        from iwae_replication_project_tpu.training import create_train_state
+        spec = ObjectiveSpec("IWAE", k=4)
+        state = create_train_state(jax.random.PRNGKey(0), CFG)
+        x = jnp.asarray((np.random.RandomState(0).rand(64, 784) > 0.5)
+                        .astype(np.float32))
+        return spec, state, x
+
+    def test_on_off_trainstate_bit_identical(self):
+        """Diagnostics observe; they must not perturb. Same key, same data:
+        params, opt state and losses agree bitwise between modes."""
+        from iwae_replication_project_tpu.training.epoch import make_epoch_fn
+        spec, state, x = self._setup()
+        off = make_epoch_fn(spec, CFG, 64, 16, donate=False)
+        on = make_epoch_fn(spec, CFG, 64, 16, donate=False,
+                           diagnostics=DiagnosticsConfig(snr_window=2))
+        s_off, losses_off = off(state, x)
+        s_on, (losses_on, diag) = on(state, x)
+        np.testing.assert_array_equal(np.asarray(losses_off),
+                                      np.asarray(losses_on))
+        for a, b in zip(jax.tree.leaves(s_off.params),
+                        jax.tree.leaves(s_on.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for k in ("diag/grad_snr", "diag/grad_snr_enc", "diag/grad_snr_dec"):
+            v = float(diag[k])
+            assert np.isfinite(v) and v > 0, (k, v)
+
+    def test_disabled_config_equals_none(self):
+        """DiagnosticsConfig(enabled=False) must take the byte-identical
+        no-diagnostics path: plain (state, losses) return shape."""
+        from iwae_replication_project_tpu.training.epoch import make_epoch_fn
+        spec, state, x = self._setup()
+        fn = make_epoch_fn(spec, CFG, 64, 16, donate=False,
+                           diagnostics=DiagnosticsConfig(enabled=False))
+        s, losses = fn(state, x)
+        assert losses.shape == (4,)
+
+    def test_block_mode_reports_last_epoch(self):
+        from iwae_replication_project_tpu.training.epoch import make_epoch_fn
+        spec, state, x = self._setup()
+        single = make_epoch_fn(spec, CFG, 64, 16, donate=False,
+                               diagnostics=DiagnosticsConfig(snr_window=2))
+        block = make_epoch_fn(spec, CFG, 64, 16, donate=False,
+                              diagnostics=DiagnosticsConfig(snr_window=2),
+                              epochs_per_call=3)
+        s1, (l1, d1) = single(state, x)
+        s2, (l2, d2) = single(s1, x)
+        s3, (l3, d3) = single(s2, x)
+        sb, (lb, db) = block(state, x)
+        np.testing.assert_array_equal(
+            np.asarray(lb),
+            np.concatenate([np.asarray(l) for l in (l1, l2, l3)]))
+        for k in d3:
+            assert float(db[k]) == pytest.approx(float(d3[k]), rel=1e-5), k
+
+    def test_parallel_epoch_diagnostics_replicated(self, devices):
+        from iwae_replication_project_tpu.parallel import make_mesh
+        from iwae_replication_project_tpu.parallel.dp import (
+            make_parallel_epoch_fn, replicate)
+        from iwae_replication_project_tpu.training import create_train_state
+        spec = ObjectiveSpec("IWAE", k=4)
+        mesh = make_mesh(dp=4, sp=2)
+        state = create_train_state(jax.random.PRNGKey(0), CFG)
+        x = jnp.asarray((np.random.RandomState(0).rand(64, 784) > 0.5)
+                        .astype(np.float32))
+        fn = make_parallel_epoch_fn(
+            spec, CFG, mesh, 64, 16, donate=False,
+            diagnostics=DiagnosticsConfig(snr_window=2))
+        state_r, (losses, diag) = fn(replicate(mesh, state),
+                                     replicate(mesh, x))
+        assert losses.shape == (4,)
+        for k, v in diag.items():
+            assert np.isfinite(float(v)) and float(v) > 0, k
+
+
+# ---------------------------------------------------------------------------
+# driver integration: the digits smoke of the acceptance criteria
+# ---------------------------------------------------------------------------
+
+class TestDriverIntegration:
+    DIAG_KEYS = ("diag/ess", "diag/ess_frac", "diag/log_weight_var",
+                 "diag/kl_q_p", "diag/active_units", "diag/grad_snr",
+                 "diag/grad_snr_enc", "diag/grad_snr_dec")
+
+    def _cfg(self, tmp_path, **over):
+        from iwae_replication_project_tpu.utils.config import ExperimentConfig
+        d = dict(dataset="digits", data_dir=str(tmp_path / "data"),
+                 n_hidden_encoder=(16,), n_hidden_decoder=(16,),
+                 n_latent_encoder=(4,), n_latent_decoder=(784,),
+                 loss_function="IWAE", k=4, batch_size=32, n_stages=2,
+                 eval_k=4, nll_k=8, nll_chunk=4, eval_batch_size=16,
+                 activity_samples=8, save_figures=False,
+                 log_dir=str(tmp_path / "runs"),
+                 checkpoint_dir=str(tmp_path / "ckpt"))
+        d.update(over)
+        return ExperimentConfig(**d)
+
+    def test_digits_smoke_emits_diagnostics_per_eval(self, tmp_path):
+        """Acceptance: a digits smoke run emits ESS, log-weight variance and
+        gradient SNR per eval into metrics.jsonl (and TensorBoard), with the
+        span/registry telemetry in its own runs/<run>/telemetry stream."""
+        from iwae_replication_project_tpu.experiment import run_experiment
+        from tests.test_logging import decode_tfevents
+
+        cfg = self._cfg(tmp_path)
+        _, history = run_experiment(cfg, max_batches_per_pass=2,
+                                    eval_subset=32)
+        run_dir = os.path.join(cfg.log_dir, cfg.run_name())
+        rows = [json.loads(ln) for ln in open(
+            os.path.join(run_dir, "metrics.jsonl"))]
+        assert [r["stage"] for r in rows] == [1, 2]  # one row per eval, only
+        for row in rows:
+            for key in self.DIAG_KEYS:
+                assert key in row and np.isfinite(row[key]), key
+            assert 1.0 <= row["diag/ess"] <= cfg.eval_k
+        # the same tags reached TensorBoard
+        (events_file,) = [f for f in os.listdir(run_dir)
+                          if f.startswith("events.out.tfevents.")]
+        tags = {v["tag"] for ev in decode_tfevents(
+            os.path.join(run_dir, events_file))[1:] for v in ev["values"]}
+        assert set(self.DIAG_KEYS) <= tags
+        # span telemetry landed in the side stream, not metrics.jsonl
+        trows = [json.loads(ln) for ln in open(
+            os.path.join(run_dir, "telemetry", "metrics.jsonl"))]
+        assert len(trows) == 2
+        assert any(k.startswith("span/train/stage") for k in trows[-1])
+        assert any(k.startswith("span/eval/") for k in trows[-1])
+        # ... and the history the caller gets carries the same scalars
+        assert all(k in history[-1][0] for k in self.DIAG_KEYS)
+
+    def test_no_diagnostics_restores_pre_telemetry_stream(self, tmp_path):
+        from iwae_replication_project_tpu.experiment import run_experiment
+
+        cfg = self._cfg(tmp_path, diagnostics=False, n_stages=1)
+        _, history = run_experiment(cfg, max_batches_per_pass=2,
+                                    eval_subset=32)
+        run_dir = os.path.join(cfg.log_dir, cfg.run_name())
+        row = json.loads(open(os.path.join(
+            run_dir, "metrics.jsonl")).read().strip().splitlines()[-1])
+        assert not any(k.startswith("diag/") for k in row)
+        assert not os.path.exists(os.path.join(run_dir, "telemetry"))
+
+    def test_cli_flags(self):
+        from iwae_replication_project_tpu.utils.config import config_from_args
+        assert config_from_args([]).diagnostics is True
+        assert config_from_args(["--no-diagnostics"]).diagnostics is False
+        assert config_from_args(["--snr-window", "7"]).snr_window == 7
