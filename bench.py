@@ -285,6 +285,21 @@ SERVING_MAX_BATCH = 32
 SERVING_BATCHES = 60           # request batches per offered-load level
 SERVING_RATES = (0.0, 50.0)    # batches/sec offered; 0 = closed loop
 SERVING_WARM_REPS = 25         # single-request warm-latency reps
+#: serial-vs-pipelined comparison: the latency-tier op point (small bucket,
+#: modest k — the pre-filled queue coalesces everything into full
+#: max_batch-sized dispatches, so the measured regime is a uniform stream
+#: of bucket-4 programs) where per-dispatch host work — coalesce, pad,
+#: device_put, enqueue, fetch, futures — is commensurate with device
+#: compute. That is the regime the two-stage pipeline exists for: big
+#: bucket-saturating k=50 batches are ~97% device-bound on this box and
+#: overlap can't show there (the load sweep above covers ragged traffic).
+SERVING_PIPE_ROWS = 420        # rows per serial/pipelined rep
+SERVING_PIPE_K = 10
+SERVING_PIPE_MAX_BATCH = 4
+SERVING_PIPE_REPS = 12         # paired closed-loop reps per dispatch mode
+SERVING_PIPE_INFLIGHT = 10     # deeper than the serving default (2): small
+#                                CPU executions overlap, so a deeper window
+#                                keeps every core fed during fetch stalls
 
 
 def bench_serving():
@@ -302,7 +317,13 @@ def bench_serving():
       dispatcher: completed rows/sec + per-bucket p50/p95/p99 from the
       engine's histograms;
     * **zero-recompile proof** — ``cache_stats`` delta across the whole
-      post-warmup stream (aot_misses and persistent-cache misses must be 0).
+      post-warmup stream (aot_misses and persistent-cache misses must be 0);
+    * **serial vs pipelined closed loop** — the same warmed program set, the
+      same request stream, dispatched serially (``max_inflight=0``: the
+      dispatcher blocks on every fetch) vs through the two-stage pipeline
+      (async enqueue + completion thread, bounded in-flight window): the
+      throughput ratio is the dispatch-overlap payoff, and the per-request
+      results must be bitwise identical across modes.
 
     Prints one JSON line and writes results/serving_bench.json.
     """
@@ -374,6 +395,93 @@ def bench_serving():
     snap = eng.metrics.snapshot()
     p99 = {name: round(s["p99_s"], 6)
            for name, s in snap["latency"].items() if s["p99_s"] is not None}
+
+    # -- serial vs pipelined closed loop: the dispatch-overlap payoff -------
+    # Two fresh engines over the SAME weights, warmed onto the same AOT
+    # registry entries (second warmup = zero compiles), fed the IDENTICAL
+    # request stream in identical order: per-request seeds line up, so the
+    # two modes must return bitwise-identical per-request results — the
+    # pipeline only changes WHEN stages run, never what they compute.
+    # The queue is pre-filled before each timed drain so batch formation is
+    # deterministic and identical across modes (a live submitter thread
+    # makes coalescing — and therefore the program mix — depend on dispatch
+    # timing, which would compare different work, not different dispatch):
+    # every dispatch is a full max_batch bucket, zero padding.
+    rng = np.random.RandomState(7)
+    stream = (rng.rand(SERVING_PIPE_ROWS, 784) > 0.5).astype(np.float32)
+    n_rows = len(stream)
+
+    def closed_loop(e):
+        futures = [e.submit("score", row) for row in stream]
+        t0 = time.perf_counter()
+        e.start()
+        # wait on the tail future first (FIFO completion: once it lands the
+        # rest are done), so the measuring thread sleeps through the drain
+        # instead of waking per future and stealing GIL time from the
+        # engine threads — same treatment for both modes
+        futures[-1].result()
+        results = [f.result() for f in futures]
+        wall = time.perf_counter() - t0
+        e.stop()
+        return wall, results
+
+    mk = lambda mi: ServingEngine(params=params, model_config=cfg,
+                                  k=SERVING_PIPE_K,
+                                  max_batch=SERVING_PIPE_MAX_BATCH,
+                                  max_inflight=mi, queue_limit=4 * n_rows,
+                                  timeout_s=None)
+    modes = {"serial": mk(0), "pipelined": mk(SERVING_PIPE_INFLIGHT)}
+    for e in modes.values():
+        e.warmup(ops=("score",))
+    sp0 = cache_stats()
+    walls = {name: [] for name in modes}
+    outs = {}
+    # one untimed round per mode first (thread spawn, allocator, frequency
+    # ramp), then paired reps — the two modes run back to back within a
+    # pair, alternating which goes first, so machine noise hits both evenly;
+    # seeds advance identically (same submit count per round), keeping
+    # round j bitwise-comparable across modes
+    for rep in range(-1, SERVING_PIPE_REPS):
+        order = list(modes) if rep % 2 else list(modes)[::-1]
+        for name in order:
+            wall, results = closed_loop(modes[name])
+            if rep < 0:
+                outs[name] = results   # warm round: parity data only
+            else:
+                walls[name].append(wall)
+    spd = stats_delta(sp0)
+    bitwise = all(np.array_equal(a, b)
+                  for a, b in zip(outs["serial"], outs["pipelined"]))
+    ratios = sorted(s / p for s, p in zip(walls["serial"],
+                                          walls["pipelined"]))
+    median_ratio = (ratios[len(ratios) // 2] if len(ratios) % 2 else
+                    (ratios[len(ratios) // 2 - 1] +
+                     ratios[len(ratios) // 2]) / 2)
+    best = {name: min(w) for name, w in walls.items()}
+    pipe_cmp = {
+        # the measured regime: a uniform stream of full bucket-sized
+        # dispatches (pre-filled queue -> max coalescing, zero padding)
+        "op_point": {"k": SERVING_PIPE_K,
+                     "bucket": SERVING_PIPE_MAX_BATCH},
+        "dispatches_per_rep": n_rows // SERVING_PIPE_MAX_BATCH,
+        "rows_per_rep": n_rows,
+        "reps": SERVING_PIPE_REPS,
+        "max_inflight": SERVING_PIPE_INFLIGHT,
+        "serial_rows_per_sec": round(n_rows / best["serial"], 2),
+        "pipelined_rows_per_sec": round(n_rows / best["pipelined"], 2),
+        # the headline: ratio of each mode's best wall (standard best-of-N —
+        # the pipeline's overlap needs the second core, so a neighbor on
+        # this shared box collapses individual reps; each mode's best rep is
+        # its least-contended measurement). Per-pair ratios + the median are
+        # committed alongside so the spread stays visible.
+        "pipelined_over_serial": round(best["serial"] / best["pipelined"], 3),
+        "pipelined_over_serial_median_pair": round(median_ratio, 3),
+        "pipelined_over_serial_pairs": [round(r, 3) for r in ratios],
+        "bitwise_identical": bool(bitwise),
+        "post_warmup_aot_misses": int(spd["aot_misses"]),
+        "post_warmup_recompiles": int(spd["persistent_cache_misses"]),
+    }
+
     out = {
         "metric": "online serving: dynamic micro-batching over AOT warm "
                   "paths (IWAE-k50-2L score)",
@@ -387,6 +495,7 @@ def bench_serving():
         "warm_over_cold": round(warm_p50 / cold_s, 6),
         "warmup": warm_info,
         "load_sweep": levels,
+        "pipeline_comparison": pipe_cmp,
         "p99_per_bucket_seconds": p99,
         "padding_waste": round(snap["padding_waste"], 4),
         # zero-recompile proof across the whole post-warmup stream

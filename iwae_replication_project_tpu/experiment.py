@@ -352,6 +352,11 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         # fields stays honest (scripts/dress_rehearsal.py uses it).
         res["stage_train_seconds"] = round(train_s - ckpt_s, 3)
         res["stage_checkpoint_seconds"] = round(ckpt_s, 3)
+        # the cadence the row was produced under (0 = end-of-stage saves
+        # only), so rows from different --checkpoint-every-passes settings
+        # are identifiable when comparing derived steps/s (ADVICE r5)
+        res["checkpoint_every_passes"] = float(
+            cfg.checkpoint_every_passes or 0)
         res["stage_passes_timed"] = float(passes - offset)
         res["stage_eval_seconds"] = round(time.perf_counter() - t_eval, 3)
         # warm-path accounting for THIS stage (utils/compile_cache.py): how

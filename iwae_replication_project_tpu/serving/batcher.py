@@ -1,8 +1,11 @@
 """Dynamic micro-batcher: queue, coalesce, expire — no device code here.
 
 Pure data-structure layer so every policy decision is unit-testable with an
-injected fake clock (tests/test_serving.py): the engine owns the thread and
-the device dispatch, this module owns WHEN a batch forms.
+injected fake clock (tests/test_serving.py): the engine owns the threads and
+the device dispatch, this module owns WHEN a batch forms
+(:class:`MicroBatcher`) and HOW MANY dispatched batches may be outstanding
+at once (:class:`InflightWindow` — the bounded hand-off between the
+dispatcher and completion stages of the pipelined engine).
 
 Policy (per coalescing group — requests only batch with same-program peers,
 i.e. identical ``(op, k)``):
@@ -13,16 +16,21 @@ i.e. identical ``(op, k)``):
 * a request whose deadline passes while queued is completed with a
   :class:`RequestTimeout` error — never dispatched, never a crash;
 * ``submit`` on a full queue raises :class:`EngineOverloaded` — bounded
-  memory and an explicit shed signal instead of an OOM/latency collapse.
+  memory and an explicit shed signal instead of an OOM/latency collapse;
+* the dispatcher stalls (stops coalescing new dispatches) once
+  ``max_inflight`` batches are outstanding — backpressure that flows into
+  the queue bound above, so overload turns into shed, not unbounded
+  device/host memory.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from collections import OrderedDict, deque
 from concurrent.futures import Future
-from typing import Callable, Deque, Dict, List, Optional, Tuple
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -52,6 +60,10 @@ class Request:
     t_enqueue: float
     deadline: Optional[float]      # absolute clock time; None = no timeout
     future: Future = dataclasses.field(default_factory=Future)
+    #: stamped by the engine when the batch carrying this request is enqueued
+    #: on the device — splits observed latency into queue-wait
+    #: (t_dispatch - t_enqueue) and device-wait (completion - t_dispatch)
+    t_dispatch: Optional[float] = None
 
     @property
     def group(self) -> Tuple[str, int]:
@@ -147,3 +159,105 @@ class MicroBatcher:
                 cand = min(cand, q[0].deadline)
             t = cand if t is None else min(t, cand)
         return t
+
+
+class InflightWindow:
+    """Bounded FIFO hand-off between the dispatcher and completion stages.
+
+    The dispatcher :meth:`acquire`s a slot BEFORE enqueueing a batch on the
+    device, :meth:`commit`s the in-flight handle after (or :meth:`release`s
+    the slot when the enqueue failed); the completion thread :meth:`pop`s
+    handles in dispatch order, fetches and completes, then calls
+    :meth:`done`. A slot is held from acquire until done, so at most
+    ``limit`` batches ever sit between device enqueue and future
+    completion: the backpressure bound that keeps device/host memory flat
+    under overload (the stalled dispatcher stops draining the request
+    queue, which then sheds at ``queue_limit``).
+
+    Pure synchronization — no device code, no clock — so pipeline mechanics
+    (saturation, drain, FIFO hand-off) are unit-testable without real device
+    timing (tests/test_serving.py).
+    """
+
+    def __init__(self, limit: int,
+                 on_change: Optional[Callable[[int], None]] = None):
+        if limit < 1:
+            raise ValueError(f"limit must be >= 1, got {limit}")
+        self.limit = int(limit)
+        self._cv = threading.Condition()
+        self._q: Deque[Any] = deque()
+        self._open = 0   # acquired and not yet done()
+        # observer for the slot count (the engine's inflight gauge), invoked
+        # UNDER the window lock so two threads' updates can never land out
+        # of order (a stale write would misreport device occupancy)
+        self._on_change = on_change
+
+    def _changed(self) -> None:
+        if self._on_change is not None:
+            self._on_change(self._open)
+
+    @property
+    def inflight(self) -> int:
+        """Batches currently holding a slot (acquired, not yet done)."""
+        with self._cv:
+            return self._open
+
+    def acquire(self, abort: Optional[Callable[[], bool]] = None,
+                poll_s: float = 0.05) -> bool:
+        """Block until a slot frees, then take it. `abort` (polled) breaks
+        the wait — the slot is STILL taken (transiently exceeding the
+        limit) so a shutting-down dispatcher can never lose a batch it
+        already popped from the request queue. Returns False iff the
+        acquire was forced past the limit by `abort`."""
+        with self._cv:
+            while self._open >= self.limit:
+                if abort is not None and abort():
+                    self._open += 1
+                    self._changed()
+                    return False
+                self._cv.wait(timeout=poll_s if abort is not None else None)
+            self._open += 1
+            self._changed()
+            return True
+
+    def commit(self, item: Any) -> None:
+        """Hand an enqueued batch (under a held slot) to the completion
+        stage."""
+        with self._cv:
+            self._q.append(item)
+            self._cv.notify_all()
+
+    def release(self) -> None:
+        """Give back a held slot without committing (the enqueue failed —
+        its futures were error-completed by the dispatcher)."""
+        with self._cv:
+            self._open -= 1
+            self._changed()
+            self._cv.notify_all()
+
+    def pop(self, stop: Optional[Callable[[], bool]] = None,
+            poll_s: float = 0.05) -> Optional[Any]:
+        """Next batch in dispatch order; blocks while empty. Returns None
+        once `stop` (polled) is true AND the window is empty — the
+        completion thread's drain-then-exit contract. (An acquired-but-not-
+        yet-committed batch is safe: its committer is the dispatcher, which
+        is joined before the completion stage is stopped.)"""
+        with self._cv:
+            while not self._q:
+                if stop is not None and stop():
+                    return None
+                self._cv.wait(timeout=poll_s if stop is not None else None)
+            return self._q.popleft()
+
+    def done(self) -> None:
+        """Release the slot of a popped batch (after its futures completed)."""
+        with self._cv:
+            self._open -= 1
+            self._changed()
+            self._cv.notify_all()
+
+    def wake(self) -> None:
+        """Nudge blocked acquire/pop callers to re-check their abort/stop
+        predicates now (shutdown fast path)."""
+        with self._cv:
+            self._cv.notify_all()

@@ -9,6 +9,13 @@ engine rounds every coalesced batch UP to a small power-of-two ladder
 sliced off before results leave the engine (the per-row RNG design in
 serving/programs.py makes real-row values bitwise independent of padding —
 pinned by tests/test_serving.py's parity test).
+
+This module is also the serving stack's designated **payload host
+boundary**: :func:`as_row` / :func:`as_rows` normalize caller-provided
+request payloads (lists, arrays, any dtype) into the engine's float32 row
+layout. Payloads start on host by definition, so the conversion lives here
+— outside the host-sync-linted dispatch hot path (engine.py), where a bare
+``np.asarray`` would be indistinguishable from an accidental device fetch.
 """
 
 from __future__ import annotations
@@ -17,6 +24,29 @@ import dataclasses
 from typing import Tuple
 
 import numpy as np
+
+
+def as_row(row, n_features: int, op: str) -> np.ndarray:
+    """One request payload as a flat float32 ``[n_features]`` row.
+
+    Raises ValueError when the payload's size does not match the op's
+    feature contract (engine.row_dims).
+    """
+    row = np.asarray(row, np.float32).reshape(-1)
+    if row.shape[0] != n_features:
+        raise ValueError(f"{op} payload must have {n_features} features, "
+                         f"got {row.shape[0]}")
+    return row
+
+
+def as_rows(x) -> Tuple[np.ndarray, bool]:
+    """Caller payload as a float32 ``[n, d]`` matrix; second element flags
+    whether the input was a single row (the blocking helpers un-batch the
+    result for those)."""
+    x = np.asarray(x, np.float32)
+    single = x.ndim == 1
+    rows = x[None] if single else x.reshape(x.shape[0], -1)
+    return rows, single
 
 
 @dataclasses.dataclass(frozen=True)

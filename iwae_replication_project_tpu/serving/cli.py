@@ -52,6 +52,12 @@ def build_argparser() -> argparse.ArgumentParser:
                     default=2000.0)
     ap.add_argument("--queue-limit", dest="queue_limit", type=int,
                     default=1024)
+    ap.add_argument("--max-inflight", dest="max_inflight", type=int,
+                    default=2,
+                    help="bounded window of dispatched-but-uncompleted "
+                         "batches for the two-stage pipeline (dispatcher "
+                         "enqueues async, a completion thread fetches); "
+                         "0 = serial dispatch (the pre-pipeline baseline)")
     ap.add_argument("--timeout-s", dest="timeout_s", type=float, default=2.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--interactive", action="store_true",
@@ -85,6 +91,7 @@ def _build_engine(args):
                             max_batch=args.max_batch,
                             max_wait_us=args.max_wait_us,
                             queue_limit=args.queue_limit,
+                            max_inflight=args.max_inflight,
                             timeout_s=args.timeout_s, seed=args.seed)
         return eng
     from iwae_replication_project_tpu import zoo
@@ -93,6 +100,7 @@ def _build_engine(args):
     return zoo.serving_engine(
         ecfg, k=args.k, max_batch=args.max_batch,
         max_wait_us=args.max_wait_us, queue_limit=args.queue_limit,
+        max_inflight=args.max_inflight,
         timeout_s=args.timeout_s, seed=args.seed)
 
 

@@ -1,29 +1,43 @@
-"""ServingEngine: online inference over the AOT warm paths.
+"""ServingEngine: pipelined online inference over the AOT warm paths.
 
-Request lifecycle (ARCHITECTURE.md "Serving"):
+Request lifecycle (ARCHITECTURE.md "Serving") — a two-stage pipeline behind
+a bounded in-flight window:
 
-    submit -> bounded queue -> coalesce (max_batch / max_wait_us)
-           -> pad to shape bucket -> AOT executable dispatch
-           -> slice real rows -> complete futures
+    submit -> bounded queue -> [dispatcher thread]
+                 coalesce (max_batch / max_wait_us) -> pad to shape bucket
+                 -> async AOT enqueue (device arrays, no host sync)
+                 -> bounded in-flight window (max_inflight)
+           -> [completion thread]
+                 block on device->host fetch -> slice real rows
+                 -> complete futures, record latency split
+
+The dispatcher does policy work only: it never blocks on the device, so the
+micro-batcher keeps coalescing the NEXT batch while the device computes the
+current one(s). The completion thread owns the single blocking fetch. With
+``max_inflight=0`` the pipeline collapses to the serial mode (dispatcher
+fetches inline) — the baseline ``bench.py --serving`` compares against.
 
 The engine is in-process: callers get ``concurrent.futures.Future``s (or use
-the blocking ``score``/``encode``/``decode`` helpers). A background
-dispatcher thread drives the micro-batcher when :meth:`start` is called;
-without it, the blocking helpers drain the queue inline — fully
-deterministic, which is what the tests use.
+the blocking ``score``/``encode``/``decode`` helpers). The background
+threads spawn on :meth:`start`; without it, the blocking helpers drain the
+queue inline (serial, fully deterministic — what most tests use).
 
-Three invariants the design leans on:
+Invariants the design leans on:
 
 * **row independence** — the serving programs (serving/programs.py) key RNG
   per request, so padded-bucket dispatch is bitwise equal to unpadded
-  execution and padding rows are sliced off, never returned;
+  execution, padding rows are sliced off, and results are bitwise
+  independent of HOW work was pipelined (serial-vs-pipelined parity is
+  pinned by tests/test_serving.py);
 * **closed shape menu** — every dispatch lands on a
   :class:`~.buckets.BucketLadder` rung, pre-compiled by :meth:`warmup`
   through the AOT registry (utils/compile_cache.py): a warm engine serves
   any ragged request stream with zero compiles;
 * **bounded everything** — queue bound (:class:`EngineOverloaded` shed),
-  per-request timeout (:class:`RequestTimeout` error result), dispatch
-  errors land in the affected futures, not in the dispatcher thread.
+  in-flight window (a saturated device stalls the dispatcher, which fills
+  the queue, which sheds), per-request timeout (:class:`RequestTimeout`
+  error result), dispatch/fetch errors land in exactly the affected
+  in-flight batch's futures, never in a dead engine thread.
 """
 
 from __future__ import annotations
@@ -32,21 +46,37 @@ import dataclasses
 import threading
 import time
 from concurrent.futures import Future
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from iwae_replication_project_tpu.serving.batcher import (
     EngineOverloaded,
+    InflightWindow,
     MicroBatcher,
     Request,
     RequestTimeout,
 )
-from iwae_replication_project_tpu.serving.buckets import BucketLadder
+from iwae_replication_project_tpu.serving.buckets import (
+    BucketLadder,
+    as_row,
+    as_rows,
+)
 from iwae_replication_project_tpu.serving.metrics import ServingMetrics
 from iwae_replication_project_tpu.serving.programs import PROGRAMS
 
 __all__ = ["ServingEngine", "EngineOverloaded", "RequestTimeout"]
+
+
+@dataclasses.dataclass
+class _InFlight:
+    """One dispatched-but-uncompleted batch riding the in-flight window."""
+
+    batch: List[Request]
+    op: str
+    k: int
+    bucket: int
+    out: Any                       # device array(s), still computing
 
 
 class ServingEngine:
@@ -60,7 +90,9 @@ class ServingEngine:
     Knobs: ``k`` (default importance samples per score/encode request;
     ``None`` = the checkpoint's stored training k, else 50),
     ``max_batch``/``max_wait_us`` (coalescing policy), ``queue_limit``
-    (backpressure bound), ``timeout_s`` (per-request queue deadline; None
+    (backpressure bound), ``max_inflight`` (dispatched-but-uncompleted batch
+    window for the two-stage pipeline; ``0`` = serial dispatch, the
+    pre-pipeline behavior), ``timeout_s`` (per-request queue deadline; None
     disables), ``ladder`` (shape buckets; default powers-of-two up to
     max_batch).
     """
@@ -68,7 +100,8 @@ class ServingEngine:
     def __init__(self, source=None, *, params=None, model_config=None,
                  k: Optional[int] = None, max_batch: int = 64,
                  max_wait_us: float = 2000.0,
-                 queue_limit: int = 1024, timeout_s: Optional[float] = 2.0,
+                 queue_limit: int = 1024, max_inflight: int = 2,
+                 timeout_s: Optional[float] = 2.0,
                  ladder: Optional[BucketLadder] = None, seed: int = 0,
                  metrics: Optional[ServingMetrics] = None):
         import jax
@@ -112,6 +145,12 @@ class ServingEngine:
         self._cv = threading.Condition(self._lock)
         self._thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
+        if max_inflight < 0:
+            raise ValueError(f"max_inflight must be >= 0, got {max_inflight}")
+        self.max_inflight = int(max_inflight)
+        self._window: Optional[InflightWindow] = None
+        self._completion_thread: Optional[threading.Thread] = None
+        self._completion_stop = threading.Event()
         #: op -> required payload feature count (public: callers building
         #: requests — e.g. the CLI's load generator — read it from here)
         self.row_dims = {
@@ -138,11 +177,7 @@ class ServingEngine:
             raise ValueError(f"unknown op {op!r}; choose {sorted(PROGRAMS)}")
         _, takes_k = PROGRAMS[op]
         k = (self.k if k is None else int(k)) if takes_k else 0
-        row = np.asarray(row, np.float32).reshape(-1)
-        want = self.row_dims[op]
-        if row.shape[0] != want:
-            raise ValueError(f"{op} payload must have {want} features, "
-                             f"got {row.shape[0]}")
+        row = as_row(row, self.row_dims[op], op)
         now = self._clock()
         with self._cv:
             seed = self._seed_counter
@@ -161,13 +196,12 @@ class ServingEngine:
         return req.future
 
     def _blocking(self, op: str, x, k: Optional[int]) -> np.ndarray:
-        x = np.asarray(x, np.float32)
-        single = x.ndim == 1
-        rows = x[None] if single else x.reshape(x.shape[0], -1)
+        rows, single = as_rows(x)
         futures = [self.submit(op, r, k=k) for r in rows]
         if self._thread is None:
             self.flush()
-        out = np.stack([np.asarray(f.result()) for f in futures])
+        # completion (threaded or inline) already fetched to host ndarrays
+        out = np.stack([f.result() for f in futures])
         return out[0] if single else out
 
     def score(self, x, k: Optional[int] = None) -> np.ndarray:
@@ -203,9 +237,24 @@ class ServingEngine:
                 n += 1
 
     def start(self) -> "ServingEngine":
-        """Spawn the background dispatcher thread (idempotent)."""
+        """Spawn the background pipeline (idempotent): the dispatcher thread
+        always; the completion thread when ``max_inflight >= 1`` (pipelined
+        mode). In serial mode (``max_inflight=0``) the dispatcher alone
+        runs the pre-pipeline dispatch-then-fetch loop."""
         if self._thread is None:
             self._stop_evt.clear()
+            self._completion_stop.clear()
+            if self.max_inflight >= 1:
+                # the window updates the inflight gauge itself, under its
+                # own lock: dispatcher and completion thread both mutate the
+                # slot count, and unsynchronized read-then-set from either
+                # side could publish a stale occupancy
+                self._window = InflightWindow(
+                    self.max_inflight, on_change=self.metrics.set_inflight)
+                self._completion_thread = threading.Thread(
+                    target=self._completion_loop,
+                    name="iwae-serve-complete", daemon=True)
+                self._completion_thread.start()
             self._thread = threading.Thread(target=self._loop,
                                             name="iwae-serve-dispatch",
                                             daemon=True)
@@ -213,16 +262,34 @@ class ServingEngine:
         return self
 
     def stop(self) -> None:
-        """Stop the dispatcher and drain whatever is still queued."""
+        """Stop the pipeline, draining everything: queued requests are still
+        dispatched (inline) and every in-flight batch is fetched and
+        completed before the threads are joined — no future accepted before
+        this call is ever lost to a shutdown. (A ``submit`` that races
+        ``stop`` from another thread may land after the final drain; like
+        any submit with no pump running, it waits in the queue for the next
+        ``start``/``flush``/blocking helper — the general pump contract in
+        :meth:`submit`.)"""
         if self._thread is not None:
             self._stop_evt.set()
             with self._cv:
                 self._cv.notify_all()
+            if self._window is not None:
+                self._window.wake()     # unblock a push stalled on the window
             self._thread.join()
             self._thread = None
+        if self._completion_thread is not None:
+            # the dispatcher is gone: nothing pushes anymore. Signal drain —
+            # pop() returns every remaining in-flight batch, then None.
+            self._completion_stop.set()
+            self._window.wake()
+            self._completion_thread.join()
+            self._completion_thread = None
+            self._window = None
         self.flush()
 
     def _loop(self) -> None:
+        pipelined = self._window is not None
         while not self._stop_evt.is_set():
             with self._cv:
                 expired, batches = self._batcher.poll()
@@ -235,7 +302,31 @@ class ServingEngine:
                     continue
             self._complete_expired(expired)
             for batch in batches:
-                self._dispatch(batch)
+                if pipelined:
+                    # backpressure BEFORE the device enqueue: block while
+                    # max_inflight batches are outstanding (stall -> queue
+                    # fills -> submit sheds), so device-side memory is
+                    # bounded by the window, exactly. On shutdown the
+                    # acquire is forced so the batch is never lost.
+                    self._window.acquire(abort=self._stop_evt.is_set)
+                    inf = self._launch_routed(batch)
+                    if inf is None:
+                        self._window.release()
+                    else:
+                        self._window.commit(inf)
+                else:
+                    self._dispatch(batch)
+
+    def _completion_loop(self) -> None:
+        """The pipeline's second stage: block on each in-flight batch's
+        device->host fetch in dispatch order, slice padding, complete
+        futures. Exits only once stopped AND the window has drained."""
+        while True:
+            inf = self._window.pop(stop=self._completion_stop.is_set)
+            if inf is None:
+                return
+            self._finish(inf)
+            self._window.done()
 
     @staticmethod
     def _complete(fut: Future, result=None, exc=None) -> bool:
@@ -266,12 +357,12 @@ class ServingEngine:
         import jax
 
         program, takes_k = PROGRAMS[op]
-        kwargs = dict(base_key=self._base_key,
-                      seeds=jax.device_put(seeds))
-        if op == "decode":
-            kwargs["h_top"] = jax.device_put(payload)
-        else:
-            kwargs["x"] = jax.device_put(payload)
+        # ONE explicit transfer per dispatch (transfer_guard-clean), not
+        # two: device_put dispatch overhead is dispatcher-thread GIL time
+        # that competes with the completion stage in the pipelined mode
+        payload_dev, seeds_dev = jax.device_put((payload, seeds))
+        kwargs = dict(base_key=self._base_key, seeds=seeds_dev)
+        kwargs["h_top" if op == "decode" else "x"] = payload_dev
         static = dict(cfg=self.cfg)
         if takes_k:
             static["k"] = k
@@ -280,10 +371,13 @@ class ServingEngine:
     def _build_key(self, op: str, k: int, bucket: int) -> tuple:
         return (op, self.cfg, k, bucket)
 
-    def _dispatch(self, batch: List[Request]) -> None:
+    def _launch(self, batch: List[Request]) -> _InFlight:
+        """Stage one: pad, device_put, enqueue the async AOT dispatch.
+        Returns the in-flight handle WITHOUT synchronizing — the device
+        computes while the dispatcher returns to coalescing."""
         from iwae_replication_project_tpu.telemetry.spans import span
         from iwae_replication_project_tpu.utils.compile_cache import (
-            aot_call, cache_stats, stats_delta)
+            aot_call_async, cache_stats, stats_delta)
 
         op, k = batch[0].group
         n = len(batch)
@@ -295,31 +389,76 @@ class ServingEngine:
         program, _ = PROGRAMS[op]
         args, kwargs, static = self._dispatch_args(op, k, payload, seeds)
         s0 = cache_stats()
-        try:
-            # spans nest: serve/dispatch/aot/serve_<op> — the outer one (in
-            # the engine's own registry) covers pad+device_put+execute+fetch
-            with span(f"serve/dispatch/{op}", registry=self.metrics.registry):
-                out = np.asarray(aot_call(
-                    f"serve_{op}", program, args,
-                    kwargs=kwargs, static_kwargs=static,
-                    build_key=self._build_key(op, k, bucket)))
-        except Exception as e:  # dispatch failure -> per-request error,
-            for r in batch:     # never a dead dispatcher thread
-                self.metrics.count("errors")
-                self._complete(r.future, exc=e)
-            return
+        # spans nest: serve/dispatch/aot/serve_<op> — the outer one (in the
+        # engine's own registry) covers pad+device_put+enqueue, NOT device
+        # completion (that is the completion stage's serve/complete span)
+        with span(f"serve/dispatch/{op}", registry=self.metrics.registry):
+            out = aot_call_async(
+                f"serve_{op}", program, args,
+                kwargs=kwargs, static_kwargs=static,
+                build_key=self._build_key(op, k, bucket))
         d = stats_delta(s0)
-        now = self._clock()
+        t_disp = self._clock()
+        for r in batch:
+            r.t_dispatch = t_disp
         self.metrics.count("dispatches")
         self.metrics.count("real_rows", n)
         self.metrics.count("padded_rows", bucket - n)
         self.metrics.count("aot_hits", d["aot_hits"])
         self.metrics.count("aot_misses", d["aot_misses"])
         self.metrics.count("recompiles", d["persistent_cache_misses"])
-        for i, r in enumerate(batch):
-            self.metrics.record_latency(op, bucket, now - r.t_enqueue)
+        return _InFlight(batch=batch, op=op, k=k, bucket=bucket, out=out)
+
+    def _launch_routed(self, batch: List[Request]) -> Optional[_InFlight]:
+        """:meth:`_launch` with enqueue-failure routing: an exception lands
+        in exactly this batch's futures, never in the dispatcher thread."""
+        try:
+            return self._launch(batch)
+        except Exception as e:
+            for r in batch:
+                self.metrics.count("errors")
+                self._complete(r.future, exc=e)
+            return None
+
+    def _fetch(self, out) -> np.ndarray:
+        """The pipeline's ONE blocking device->host transfer (completion
+        stage). Async dispatch errors (including deferred device-side
+        failures) surface here."""
+        return np.asarray(out)  # iwaelint: disable=host-sync -- the completion stage's designated fetch: blocking D2H is this thread's entire job; the dispatch hot path stays sync-free
+
+    def _finish(self, inf: _InFlight) -> None:
+        """Stage two: fetch, slice padding, complete this batch's futures.
+        A fetch failure (async device errors surface at the transfer) is
+        routed to exactly this in-flight batch's futures."""
+        from iwae_replication_project_tpu.telemetry.spans import span
+
+        try:
+            with span(f"serve/complete/{inf.op}",
+                      registry=self.metrics.registry):
+                out = self._fetch(inf.out)
+        except Exception as e:
+            for r in inf.batch:
+                self.metrics.count("errors")
+                self._complete(r.future, exc=e)
+            return
+        now = self._clock()
+        for i, r in enumerate(inf.batch):
+            self.metrics.record_latency(inf.op, inf.bucket, now - r.t_enqueue)
+            if r.t_dispatch is not None:
+                self.metrics.record_queue_wait(inf.op, inf.bucket,
+                                               r.t_dispatch - r.t_enqueue)
+                self.metrics.record_device_wait(inf.op, inf.bucket,
+                                                now - r.t_dispatch)
             if self._complete(r.future, result=out[i]):
                 self.metrics.count("completed")
+
+    def _dispatch(self, batch: List[Request]) -> None:
+        """Serial dispatch: launch then immediately fetch-and-complete on
+        the calling thread — the inline :meth:`flush` path and the
+        ``max_inflight=0`` baseline mode."""
+        inf = self._launch_routed(batch)
+        if inf is not None:
+            self._finish(inf)
 
     # ------------------------------------------------------------------
     # warmup

@@ -27,8 +27,13 @@ from iwae_replication_project_tpu.telemetry.registry import (
     MetricRegistry,
 )
 
-#: registry namespace for the per-(op, bucket) histograms
+#: registry namespaces for the per-(op, bucket) histograms: total observed
+#: latency plus its pipeline split — queue_wait (submit -> device enqueue:
+#: coalescing policy + in-flight backpressure) and device_wait (enqueue ->
+#: fetched: device compute + D2H). queue_wait + device_wait ~= latency.
 _LAT = "latency/"
+_QW = "queue_wait/"
+_DW = "device_wait/"
 
 
 class LatencyHistogram(Histogram):
@@ -58,6 +63,7 @@ class ServingMetrics:
         for name in self.COUNTERS:
             self.registry.counter(name)
         self._queue_depth = self.registry.gauge("queue_depth")
+        self._inflight = self.registry.gauge("inflight")
 
     def count(self, name: str, n: float = 1) -> None:
         self.registry.counter(name).inc(n)
@@ -69,40 +75,70 @@ class ServingMetrics:
     def queue_depth(self) -> int:
         return int(self._queue_depth.value)
 
+    def set_inflight(self, n: int) -> None:
+        """Batches currently between device enqueue and future completion
+        (the pipeline's bounded window occupancy; 0 when idle or serial)."""
+        self._inflight.set(int(n))
+
+    @property
+    def inflight(self) -> int:
+        return int(self._inflight.value)
+
     def record_latency(self, op: str, bucket: int, seconds: float) -> None:
         self.registry.histogram(f"{_LAT}{op}/b{bucket}",
+                                factory=LatencyHistogram).record(seconds)
+
+    def record_queue_wait(self, op: str, bucket: int, seconds: float) -> None:
+        self.registry.histogram(f"{_QW}{op}/b{bucket}",
+                                factory=LatencyHistogram).record(seconds)
+
+    def record_device_wait(self, op: str, bucket: int,
+                           seconds: float) -> None:
+        self.registry.histogram(f"{_DW}{op}/b{bucket}",
                                 factory=LatencyHistogram).record(seconds)
 
     # -- export ------------------------------------------------------------
 
     def snapshot(self) -> dict:
         """The nested JSON document: counters, derived rates, per-bucket
-        latency summaries. Padding waste = fraction of dispatched rows that
+        latency summaries — total (``latency``) plus the pipeline split
+        (``queue_wait`` / ``device_wait``, recorded per request at
+        completion). Padding waste = fraction of dispatched rows that
         were filler (the cost of the bucket ladder; high values mean the
         ladder is too coarse for the observed size mix)."""
         snap = self.registry.snapshot()
         c = {k: snap["counters"].get(k, 0) for k in self.COUNTERS}
         rows = c["real_rows"] + c["padded_rows"]
+
+        def section(prefix):
+            return {name[len(prefix):]: s
+                    for name, s in snap["histograms"].items()
+                    if name.startswith(prefix)}
+
         return {
             "counters": c,
             "queue_depth": int(snap["gauges"].get("queue_depth", 0)),
+            "inflight": int(snap["gauges"].get("inflight", 0)),
             "padding_waste": (c["padded_rows"] / rows) if rows else 0.0,
-            "latency": {name[len(_LAT):]: s
-                        for name, s in snap["histograms"].items()
-                        if name.startswith(_LAT)},
+            "latency": section(_LAT),
+            "queue_wait": section(_QW),
+            "device_wait": section(_DW),
         }
 
     def flat(self) -> Dict[str, float]:
         """Flat scalar dict for utils/logging.MetricsLogger (JSONL/TB): one
-        key per counter plus ``latency/<op>/b<bucket>/p{50,95,99}_s``."""
+        key per counter plus
+        ``{latency,queue_wait,device_wait}/<op>/b<bucket>/p{50,95,99}_s``."""
         snap = self.snapshot()
         out: Dict[str, float] = {k: float(v)
                                  for k, v in snap["counters"].items()}
         out["queue_depth"] = float(snap["queue_depth"])
+        out["inflight"] = float(snap["inflight"])
         out["padding_waste"] = float(snap["padding_waste"])
-        for name, s in snap["latency"].items():
-            for q in ("p50_s", "p95_s", "p99_s", "mean_s"):
-                if s[q] is not None:
-                    out[f"latency/{name}/{q}"] = float(s[q])
-            out[f"latency/{name}/count"] = float(s["count"])
+        for kind in ("latency", "queue_wait", "device_wait"):
+            for name, s in snap[kind].items():
+                for q in ("p50_s", "p95_s", "p99_s", "mean_s"):
+                    if s[q] is not None:
+                        out[f"{kind}/{name}/{q}"] = float(s[q])
+                out[f"{kind}/{name}/count"] = float(s["count"])
         return out

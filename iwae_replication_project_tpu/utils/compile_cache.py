@@ -11,7 +11,9 @@ module makes every production entry point compile-once, run-warm:
   calls this instead of hand-rolling ``jax.config.update`` — a lint-guard test
   (tests/test_compile_cache.py) enforces it.
 
-* an **AOT executable registry** (:func:`warm_callable`, :func:`aot_call`) —
+* an **AOT executable registry** (:func:`warm_callable`, :func:`aot_call`,
+  :func:`aot_call_async` — the explicitly-async variant pipelined callers
+  hold device results from) —
   ``.lower().compile()`` runs once per ``(program, static build key, arg
   shapes/dtypes/shardings)`` signature and the compiled executable is reused
   across the 8 Burda stages, across ``PASS_BLOCK`` dispatches, and across
@@ -251,11 +253,22 @@ def _registry_get_or_compile(name: str, jitted_fn: Callable, args: Tuple,
     return exe
 
 
-def aot_call(name: str, jitted_fn: Callable, args: Tuple = (),
-             kwargs: Optional[dict] = None,
-             static_kwargs: Optional[dict] = None,
-             build_key: Tuple = ()) -> Any:
-    """Call ``jitted_fn(*args, **kwargs, **static_kwargs)`` via the registry.
+def aot_call_async(name: str, jitted_fn: Callable, args: Tuple = (),
+                   kwargs: Optional[dict] = None,
+                   static_kwargs: Optional[dict] = None,
+                   build_key: Tuple = ()) -> Any:
+    """Enqueue ``jitted_fn(*args, **kwargs, **static_kwargs)`` via the
+    registry and return the resulting **device arrays without any host
+    synchronization** — the explicitly-async AOT call path.
+
+    JAX dispatch is asynchronous: the returned arrays are futures over
+    device buffers, and the call returns as soon as the execution is queued.
+    Callers that pipeline (the serving engine's dispatcher thread) hold the
+    result and perform the blocking device→host fetch (``np.asarray``)
+    elsewhere — overlapping the next dispatch with the in-flight compute.
+    Shares the executable registry, the hit/miss accounting, and the
+    ``aot/<name>`` span with :func:`aot_call` (the span time is enqueue, not
+    device completion, by design).
 
     First call per ``(name, build_key, signature(args, kwargs))``:
     ``jitted_fn.lower(...).compile()`` (a registry *miss*; the lower+compile
@@ -265,11 +278,6 @@ def aot_call(name: str, jitted_fn: Callable, args: Tuple = (),
     the jaxpr. ``build_key`` must capture everything the caller baked into
     the closure (objective spec, model config, n_train, donation, mesh, ...):
     two distinct programs must never share a registry slot.
-
-    Donation declared on `jitted_fn` is preserved by the compiled executable.
-    The executable is invoked with the dynamic arguments only
-    (`static_kwargs` are compile-time constants, already burned into the
-    program — pass statics that interleave positionally by keyword).
     """
     kwargs = kwargs or {}
     exe = _registry_get_or_compile(name, jitted_fn, args, kwargs,
@@ -281,6 +289,26 @@ def aot_call(name: str, jitted_fn: Callable, args: Tuple = (),
     from iwae_replication_project_tpu.telemetry.spans import span
     with span(f"aot/{name}"):
         return exe(*args, **kwargs)
+
+
+def aot_call(name: str, jitted_fn: Callable, args: Tuple = (),
+             kwargs: Optional[dict] = None,
+             static_kwargs: Optional[dict] = None,
+             build_key: Tuple = ()) -> Any:
+    """Call ``jitted_fn(*args, **kwargs, **static_kwargs)`` via the registry.
+
+    The historical name for :func:`aot_call_async` — JAX dispatch has always
+    been async, so the two are the same operation; use ``aot_call_async``
+    where the no-host-sync contract is load-bearing (pipelined serving) and
+    this name where the caller fetches (or chains) immediately.
+
+    Donation declared on `jitted_fn` is preserved by the compiled executable.
+    The executable is invoked with the dynamic arguments only
+    (`static_kwargs` are compile-time constants, already burned into the
+    program — pass statics that interleave positionally by keyword).
+    """
+    return aot_call_async(name, jitted_fn, args, kwargs=kwargs,
+                          static_kwargs=static_kwargs, build_key=build_key)
 
 
 def aot_warm(name: str, jitted_fn: Callable, args: Tuple = (),

@@ -8,7 +8,11 @@
 2. **telemetry smoke** (scripts/telemetry_smoke.py) — registry export,
    span nesting, jitted ESS identities, and all three exporter surfaces
    (JSONL/TB, Prometheus text, /metrics HTTP) under ``JAX_PLATFORMS=cpu``;
-3. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with ``--sanitize``
+3. **serving smoke** (scripts/serving_smoke.py) — the pipelined dispatch
+   path on a warm engine under a ragged burst: zero recompiles after
+   warmup, zero lost futures through a mid-burst ``stop()``, in-flight
+   window drained;
+4. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with ``--sanitize``
    armed, so the marked subset additionally runs under
    ``jax.transfer_guard("disallow")`` + ``jax.debug_nans``. The serving
    subsystem's fast tests (tests/test_serving.py: batcher policy,
@@ -49,6 +53,15 @@ def run_telemetry_smoke() -> int:
         cwd=REPO, env=env)
 
 
+def run_serving_smoke() -> int:
+    print("== serving smoke: pipelined dispatch, warm engine ".ljust(72, "="))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.call(
+        [sys.executable, os.path.join("scripts", "serving_smoke.py")],
+        cwd=REPO, env=env)
+
+
 def run_tests(extra) -> int:
     print("== pytest: tier-1 (fast profile) + sanitizers ".ljust(72, "="))
     env = dict(os.environ)
@@ -72,9 +85,10 @@ def main(argv=None) -> int:
 
     single_stage = args.lint_only or args.tests_only
     rc_lint = 0 if args.tests_only else run_lint()
-    # the smoke stage rides the full gate only: --lint-only / --tests-only
+    # the smoke stages ride the full gate only: --lint-only / --tests-only
     # keep their single-stage contract
     rc_smoke = 0 if single_stage else run_telemetry_smoke()
+    rc_serve = 0 if single_stage else run_serving_smoke()
     rc_tests = 0 if args.lint_only else run_tests(passthrough)
 
     print("== check summary ".ljust(72, "="))
@@ -82,9 +96,10 @@ def main(argv=None) -> int:
         print(f"lint : {'ok' if rc_lint == 0 else f'FAILED (rc={rc_lint})'}")
     if not single_stage:
         print(f"smoke: {'ok' if rc_smoke == 0 else f'FAILED (rc={rc_smoke})'}")
+        print(f"serve: {'ok' if rc_serve == 0 else f'FAILED (rc={rc_serve})'}")
     if not args.lint_only:
         print(f"tests: {'ok' if rc_tests == 0 else f'FAILED (rc={rc_tests})'}")
-    return 1 if (rc_lint or rc_smoke or rc_tests) else 0
+    return 1 if (rc_lint or rc_smoke or rc_serve or rc_tests) else 0
 
 
 if __name__ == "__main__":

@@ -196,8 +196,11 @@ def test_stage_rows_stamp_cache_stats(tmp_path):
         for field in ("aot_hits", "aot_misses", "aot_compile_seconds",
                       "compile_cache_misses", "compile_cache_hits",
                       "compile_seconds", "stage_checkpoint_seconds",
-                      "stage_train_seconds"):
+                      "stage_train_seconds", "checkpoint_every_passes"):
             assert field in row, field
+        # the cadence the row was produced under is stamped so derived
+        # steps/s is comparable across --checkpoint-every-passes settings
+        assert row["checkpoint_every_passes"] == 1.0
     # stage 1 is a single pass: the only boundary is the final one, which the
     # end-of-stage save owns -> zero mid-stage checkpoint seconds. Stage 2
     # (3 passes, cadence 1) saves after passes 1 and 2: the split-out time is

@@ -276,6 +276,222 @@ def test_background_thread_round_trip(tiny):
 
 
 # ---------------------------------------------------------------------------
+# the two-stage pipeline: window mechanics, parity, error routing, drain
+# ---------------------------------------------------------------------------
+
+def test_inflight_window_mechanics():
+    """InflightWindow is pure synchronization: slot accounting (acquire/
+    release/done), FIFO hand-off (commit/pop), forced acquire on abort
+    (shutdown must not lose batches), drain-then-None pop. No device, no
+    clock, no threads needed."""
+    from iwae_replication_project_tpu.serving.batcher import InflightWindow
+
+    w = InflightWindow(2)
+    assert w.acquire() and w.acquire()
+    assert w.inflight == 2
+    # saturated + abort: the slot is still taken (forced), reported False
+    assert w.acquire(abort=lambda: True) is False
+    assert w.inflight == 3
+    w.release()                      # a failed launch gives its slot back
+    assert w.inflight == 2
+    w.commit("a")
+    w.commit("b")
+    assert w.pop() == "a"            # dispatch order
+    w.done()
+    assert w.inflight == 1
+    assert w.pop(stop=lambda: True) == "b"   # drain: items before None
+    assert w.pop(stop=lambda: True) is None
+    with pytest.raises(ValueError):
+        InflightWindow(0)
+
+
+def test_serial_vs_pipelined_bitwise_parity(tiny):
+    """A fresh serial engine (max_inflight=0) and a fresh pipelined engine
+    fed the identical ragged stream in identical submit order mint identical
+    per-request seeds — so per-request results must be BITWISE equal, no
+    matter how differently the two modes coalesced, padded, or overlapped
+    the work. Pipelining changes when stages run, never what they compute."""
+    def run(max_inflight):
+        eng = make_engine(tiny, max_batch=8, max_wait_us=200.0,
+                          max_inflight=max_inflight)
+        eng.start()
+        try:
+            futs = []
+            for n in (1, 3, 7, 2, 8, 5, 1, 4):
+                for r in tiny["x"][:n]:
+                    futs.append(eng.submit("score", r))
+            return [np.asarray(f.result(timeout=120)) for f in futs]
+        finally:
+            eng.stop()
+
+    serial, pipelined = run(0), run(2)
+    assert len(serial) == len(pipelined) == 31
+    for a, b in zip(serial, pipelined):
+        assert a.dtype == b.dtype and np.array_equal(a, b)
+
+
+def test_dispatch_exception_routes_to_affected_batch(tiny, monkeypatch):
+    """An enqueue-time failure lands in exactly the affected batch's futures
+    (here: the k=8 coalescing group); other groups complete normally and the
+    engine keeps serving."""
+    from iwae_replication_project_tpu.serving.engine import ServingEngine
+
+    eng = make_engine(tiny)
+    real = ServingEngine._launch
+
+    def boom(self, batch):
+        if batch[0].k == 8:
+            raise RuntimeError("boom")
+        return real(self, batch)
+
+    monkeypatch.setattr(ServingEngine, "_launch", boom)
+    good = [eng.submit("score", r, k=4) for r in tiny["x"][:3]]
+    bad = [eng.submit("score", r, k=8) for r in tiny["x"][3:5]]
+    eng.flush()
+    for f in good:
+        assert np.isfinite(f.result(timeout=60))
+    for f in bad:
+        with pytest.raises(RuntimeError, match="boom"):
+            f.result(timeout=60)
+    c = eng.metrics.snapshot()["counters"]
+    assert c["errors"] == 2 and c["completed"] == 3
+
+
+class _PoisonOut:
+    """A fake device result whose host fetch raises — the deferred-error
+    shape: async dispatch succeeded, the failure surfaces at the D2H."""
+
+    def __array__(self, *a, **kw):
+        raise RuntimeError("poisoned fetch")
+
+
+def test_fetch_exception_routes_to_affected_inflight_batch(tiny, monkeypatch):
+    """A failure surfacing at the completion stage's fetch is routed to
+    exactly that in-flight batch's futures; batches before/after complete,
+    and the completion thread survives."""
+    from iwae_replication_project_tpu.serving.engine import ServingEngine
+
+    eng = make_engine(tiny, max_inflight=2, max_wait_us=200.0)
+    real = ServingEngine._launch
+
+    def poison(self, batch):
+        inf = real(self, batch)
+        if inf.k == 8:
+            inf.out = _PoisonOut()
+        return inf
+
+    monkeypatch.setattr(ServingEngine, "_launch", poison)
+    eng.start()
+    try:
+        bad = [eng.submit("score", r, k=8) for r in tiny["x"][:2]]
+        good = [eng.submit("score", r, k=4) for r in tiny["x"][:3]]
+        for f in bad:
+            with pytest.raises(RuntimeError, match="poisoned"):
+                f.result(timeout=60)
+        for f in good:
+            assert np.isfinite(f.result(timeout=60))
+    finally:
+        eng.stop()
+    c = eng.metrics.snapshot()["counters"]
+    assert c["errors"] == 2 and c["completed"] == 3
+    assert eng.metrics.inflight == 0
+
+
+def test_stop_drains_work_in_flight(tiny, monkeypatch):
+    """stop() with batches queued AND in flight completes every future —
+    queued work is flushed, the window is drained, nothing is lost. A slowed
+    fetch guarantees the window is non-empty when stop() lands."""
+    from iwae_replication_project_tpu.serving.engine import ServingEngine
+
+    real = ServingEngine._fetch
+
+    def slow_fetch(self, out):
+        time.sleep(0.02)
+        return real(self, out)
+
+    monkeypatch.setattr(ServingEngine, "_fetch", slow_fetch)
+    eng = make_engine(tiny, max_inflight=2, max_wait_us=100.0)
+    eng.start()
+    futs = [eng.submit("score", r) for r in tiny["x"]]
+    eng.stop()                       # immediately: work is still in flight
+    assert all(f.done() for f in futs)
+    out = np.stack([f.result(timeout=0) for f in futs])
+    assert out.shape == (17,) and np.isfinite(out).all()
+    c = eng.metrics.snapshot()["counters"]
+    assert c["completed"] == 17 and c["errors"] == 0 and c["timeouts"] == 0
+    assert eng.metrics.inflight == 0
+
+
+def _spin_until(pred, timeout_s=10.0):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout_s:
+            raise AssertionError("condition not reached in time")
+        time.sleep(0.002)
+
+
+def test_backpressure_caps_inflight_and_feeds_shedding(tiny, monkeypatch):
+    """With the window saturated (completion gated shut), the dispatcher
+    must stop launching — at most max_inflight batches are ever enqueued on
+    the device — and the stalled queue then sheds at queue_limit. Fake
+    launch/fetch: no device, no real device timing in the loop."""
+    import threading
+
+    from iwae_replication_project_tpu.serving.engine import (
+        ServingEngine, _InFlight)
+
+    launches = []
+    gate = threading.Event()
+
+    def fake_launch(self, batch):
+        launches.append(len(batch))
+        t = self._clock()
+        for r in batch:
+            r.t_dispatch = t
+        return _InFlight(batch=batch, op=batch[0].op, k=batch[0].k,
+                         bucket=len(batch), out=None)
+
+    def fake_fetch(self, out):
+        assert gate.wait(timeout=30)
+        return np.zeros((64,), np.float32)
+
+    monkeypatch.setattr(ServingEngine, "_launch", fake_launch)
+    monkeypatch.setattr(ServingEngine, "_fetch", fake_fetch)
+    eng = make_engine(tiny, max_inflight=1, max_wait_us=0.0, queue_limit=4)
+    eng.start()
+    try:
+        futs = [eng.submit("score", tiny["x"][0])]
+        _spin_until(lambda: len(launches) == 1)   # batch 1 is in flight
+        # more submissions: the dispatcher may pop them, but must NOT launch
+        # past the window while the completion stage is gated shut
+        futs += [eng.submit("score", r) for r in tiny["x"][1:3]]
+        time.sleep(0.1)
+        assert len(launches) == 1
+        # acquire() blocks BEFORE taking the slot: exactly one batch holds
+        # the window while the completion stage is gated
+        assert eng._window.inflight == 1
+        # backpressure reaches the caller: the queue fills and sheds
+        shed = 0
+        for _ in range(eng._batcher.queue_limit + 3):
+            try:
+                futs.append(eng.submit("score", tiny["x"][3]))
+            except EngineOverloaded:
+                shed += 1
+                break
+        assert shed == 1, "saturated pipeline never shed"
+        gate.set()                    # release: everything drains
+        for f in futs:
+            assert f.result(timeout=60) is not None
+    finally:
+        gate.set()
+        eng.stop()
+    c = eng.metrics.snapshot()["counters"]
+    assert c["shed"] == 1
+    assert c["completed"] == len(futs)
+    assert eng.metrics.inflight == 0
+
+
+# ---------------------------------------------------------------------------
 # warm path: zero compiles across a ragged stream after warmup
 # ---------------------------------------------------------------------------
 
@@ -308,8 +524,17 @@ def test_metrics_accounting(tiny):
     lat = snap["latency"]["score/b4"]
     assert lat["count"] == 3
     assert lat["p50_s"] is not None and lat["p99_s"] >= lat["p50_s"]
+    # the pipeline split schema: queue-wait + device-wait per (op, bucket),
+    # recorded on the serial path too (t_dispatch is stamped either way),
+    # and the in-flight gauge (0: nothing outstanding after a blocking call)
+    assert snap["inflight"] == 0
+    assert snap["queue_wait"]["score/b4"]["count"] == 3
+    assert snap["device_wait"]["score/b4"]["count"] == 3
     flat = eng.metrics.flat()
     assert flat["latency/score/b4/count"] == 3.0
+    assert flat["queue_wait/score/b4/count"] == 3.0
+    assert flat["device_wait/score/b4/count"] == 3.0
+    assert flat["inflight"] == 0.0
     assert all(isinstance(v, float) for v in flat.values())
 
 

@@ -218,6 +218,27 @@ class TestExporters:
         assert "iwae_submitted_total 4" in page
         assert 'iwae_latency_score_b4{quantile="0.5"}' in page
 
+    def test_serving_pipeline_metrics_export(self):
+        """The pipelined-dispatch instruments — the inflight gauge and the
+        queue-wait / device-wait latency split — ride the same registry and
+        reach every export surface: Prometheus text (the CLI's /metrics
+        endpoint serves exactly this page) and the MetricsLogger flat rows.
+        Schema pinned here and in tests/test_serving.py."""
+        from iwae_replication_project_tpu.serving.metrics import ServingMetrics
+        m = ServingMetrics()
+        m.set_inflight(2)
+        m.record_queue_wait("score", 4, 0.002)
+        m.record_device_wait("score", 4, 0.009)
+        page = prometheus_text(m.registry)
+        assert "# TYPE iwae_inflight gauge" in page
+        assert "iwae_inflight 2" in page
+        assert 'iwae_queue_wait_score_b4{quantile="0.5"}' in page
+        assert 'iwae_device_wait_score_b4{quantile="0.5"}' in page
+        flat = m.flat()
+        assert flat["inflight"] == 2.0
+        assert flat["queue_wait/score/b4/count"] == 1.0
+        assert flat["device_wait/score/b4/count"] == 1.0
+
 
 # ---------------------------------------------------------------------------
 # on-device diagnostics
