@@ -36,10 +36,10 @@ from iwae_replication_project_tpu.ops.logsumexp import (
 )
 
 
-def largest_divisor_leq(n: int, cap: int) -> int:
-    """Largest divisor of `n` not exceeding `cap` — used to adapt requested
-    chunk/batch sizes to whatever the data actually divides into."""
-    return max(d for d in range(1, min(cap, n) + 1) if n % d == 0)
+# canonical home: utils/flops.py (ops/hot_loop needs it too and cannot
+# import evaluation/); re-exported here because this was its historical home
+# and parallel/eval imports it from this module
+from iwae_replication_project_tpu.utils.flops import largest_divisor_leq
 
 
 @partial(jax.jit, static_argnames=("cfg", "k"))
@@ -181,6 +181,12 @@ def training_statistics(params, cfg: model.ModelConfig, key: jax.Array,
     # every caller logs the true values
     acc["nll_chunk"] = float(nll_chunk)
     acc["eval_batch"] = float(batch_size)
+    # which hot-loop path the chunked NLL scorer (the eval suite's dominant
+    # pass) selects for THIS row's shape — recomputed per config, never read
+    # from trace-order state (ops/hot_loop.PATH_CODES)
+    from iwae_replication_project_tpu.ops.hot_loop import path_code_for_model
+    acc["kernel_path"] = path_code_for_model(cfg, nll_chunk, batch_size,
+                                             on_tpu=model._on_tpu())
 
     res2: Dict[str, object] = {}
     k_au, k_pruned = jax.random.split(jax.random.fold_in(key, n_batches))

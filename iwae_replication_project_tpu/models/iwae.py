@@ -53,9 +53,12 @@ class ModelConfig:
     likelihood: str = "clamp"
     # None | "bfloat16" — matmul operand dtype; accumulation stays float32.
     compute_dtype: Optional[str] = None
-    # Fuse the decoder output matmul + Bernoulli loglik + pixel reduction into
-    # one Pallas kernel so the [k, B, x_dim] logits tensor never hits HBM.
-    # Requires likelihood="logits". (ops/fused_likelihood.py)
+    # Route log p(x|h) through the blocked hot-loop dispatcher: the whole
+    # decoder output block (3 matmuls + tanh + Bernoulli + pixel reduction)
+    # fused over (k, batch) tiles so neither the [k, B, hid] hiddens nor the
+    # [k, B, x_dim] logits hit HBM; per-shape fallback to a remat'd blocked
+    # scan or the unfused composition. Requires likelihood="logits".
+    # (ops/hot_loop.py; ops/fused_likelihood.py is the k-only predecessor)
     fused_likelihood: bool = False
 
     def __post_init__(self):
@@ -173,27 +176,16 @@ def log_px_given_h(params: Params, cfg: ModelConfig, x: jax.Array,
                    h1: jax.Array) -> jax.Array:
     """``log p(x|h)`` summed over pixels -> ``[k, B]`` (flexible_IWAE.py:123-129)."""
     if cfg.fused_likelihood:
-        from iwae_replication_project_tpu.ops.fused_likelihood import (
-            fused_bernoulli_ll, kernel_usable)
-        out = params["out"]
-        y = jnp.tanh(mlp.dense_apply(out["l1"], h1, cfg.matmul_dtype))
-        y = jnp.tanh(mlp.dense_apply(out["l2"], y, cfg.matmul_dtype))
-        # oversized shapes (e.g. eval batches >= ~400 rows) exceed the
-        # kernel's scoped-VMEM budget — the unfused tail below computes
-        # the identical logits-form likelihood, so fall through silently.
-        # kernel_usable also probe-compiles once per shape/dtype (y is the
-        # actual kernel operand), so an estimate misprediction on a
-        # non-v5e generation falls back instead of crashing the jit.
-        if kernel_usable(y.shape[0], y.shape[1], out["out"]["w"].shape[0],
-                         out["out"]["w"].shape[-1], interpret=not _on_tpu(),
-                         dtype=y.dtype):
-            return fused_bernoulli_ll(y, out["out"]["w"], out["out"]["b"], x,
-                                      not _on_tpu())
-        # same math as decode_logits, reusing the y already computed
-        logits = mlp.dense_apply(out["out"], y,
-                                 cfg.matmul_dtype).astype(jnp.float32)
-        lp = dist.bernoulli_log_prob_from_logits(x, logits)
-        return jnp.sum(lp, axis=-1)
+        # the hot-loop dispatcher (ops/hot_loop.py): the FULL output block
+        # (three matmuls + tanh + Bernoulli + pixel reduction) blocked over
+        # (k, batch) tiles — Pallas where a tile fits scoped VMEM (probe-
+        # gated), a remat'd blocked scan for oversized working sets, and
+        # the unfused XLA composition otherwise. Selection is trace-time
+        # static and recorded on the telemetry registry (kernel_path).
+        from iwae_replication_project_tpu.ops import hot_loop
+        return hot_loop.decoder_score(params["out"], x, h1,
+                                      compute_dtype=cfg.matmul_dtype,
+                                      on_tpu=_on_tpu())
     logits = decode_logits(params, cfg, h1)
     if cfg.likelihood == "clamp":
         probs = dist.clamp_probs(jax.nn.sigmoid(logits))

@@ -69,9 +69,22 @@ def vae_bound(log_w: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean(log_w)
 
 
+def iwae_per_example(log_w: jnp.ndarray) -> jnp.ndarray:
+    """``[B]`` per-example k-sample bound: ``logmeanexp_k(log w)``.
+
+    The shared reduction tail of the hot loop (ops/hot_loop.py produces the
+    ``[k, B]`` log-weights; this is the ``ops.logsumexp`` step that closes
+    it): training's :func:`iwae_bound` means it over the batch, the k=5000
+    eval scorer streams it through the online-logsumexp carry, and the
+    serving ``score`` op returns it per request — one reduction definition
+    for all three workloads.
+    """
+    return logmeanexp(log_w, axis=0)
+
+
 def iwae_bound(log_w: jnp.ndarray) -> jnp.ndarray:
     """L_k = mean_B[ log mean_k exp(log w) ], max-stabilized."""
-    return jnp.mean(logmeanexp(log_w, axis=0))
+    return jnp.mean(iwae_per_example(log_w))
 
 
 def miwae_bound(log_w: jnp.ndarray, k2: int) -> jnp.ndarray:

@@ -1,0 +1,617 @@
+"""The K-sample hot loop, blocked over (k, batch) tiles.
+
+ROADMAP item 4 / BENCH_r05: the whole training workload is the ``[k, B]``
+log-weight inner loop, and the flagship train MFU sits at ~0.136 — an order
+of magnitude under the bf16 roofline. The per-step hot path is
+
+    encoder matmuls -> reparameterized K-sampling -> scoring
+        (log p(x|h) + log p(h) - log q(h|x)) -> logsumexp reduction
+
+and its FLOPs/bytes are dominated by the decoder *output block*: for the
+2-layer flagship, ``h1 @ W1 -> tanh -> @ W2 -> tanh -> @ W3 -> Bernoulli``
+is ~77% of all k-scaled matmul MACs and >90% of the activation bytes (the
+``[k, B, 200]`` hiddens and the ``[k, B, 784]`` logits). The predecessor
+kernel (ops/fused_likelihood.py) fused only the FINAL matmul of that block;
+this module extends the fused region to the whole block and tiles it over
+BOTH the k and batch axes, so shapes the k-only kernel had to reject (eval
+batches >= ~300) stay fused.
+
+Three selectable implementations of the same math, chosen per shape at trace
+time by :func:`select_path` (``kernel_usable``-style: analytic VMEM estimate
+under ops.fused_likelihood._vmem_budget, then one probe compile per shape):
+
+* ``pallas``      — the blocked TPU kernel below: per (k-tile, batch-tile),
+  all three matmuls ride the MXU with the intermediates living only in VMEM;
+  the backward is a tile-local-recompute custom VJP (flash-attention-style)
+  that rebuilds y1/y2/logits per tile and accumulates dW/db across the
+  sequential grid. The backward tile is chosen independently of the forward
+  (its working set is ~1.6x larger), and falls back to the XLA backward on
+  its own when no tile fits. ``interpret=True`` runs the same kernel on CPU
+  for the parity tests and the smoke gate.
+* ``blocked_scan`` — the hand-blocked fallback wherever Pallas is
+  unavailable: a ``lax.scan`` over k-slabs of the identical per-slab math
+  under ``jax.checkpoint``, so the forward materializes only one slab of
+  logits at a time and the backward *recomputes* per slab instead of saving
+  the full ``[k, B, 784]`` tensor — the same remat/layout policy as the
+  kernel, expressed in XLA.
+* ``reference``   — the straight XLA composition (also the parity oracle).
+
+Selection is recorded through the PR-4 telemetry registry: a ``kernel_path``
+gauge (see :data:`PATH_CODES`), per-path counters ``kernel_path/<path>``,
+and ``span/kernel/select/<path>`` spans timing the probe work — so bench and
+serving rows can stamp which path actually ran.
+
+Env levers (all read at trace/selection time):
+
+* ``IWAE_HOT_LOOP_PATH`` — force ``pallas`` / ``blocked_scan`` /
+  ``reference`` (default ``auto``);
+* ``IWAE_HOT_LOOP_SCAN_BYTES`` — working-set threshold above which ``auto``
+  prefers the blocked scan over the materializing reference composition when
+  the kernel is unavailable (default 256 MiB off-TPU, disabled on TPU where
+  HBM absorbs the reference path at r05 behavior);
+* ``IWAE_FUSED_VMEM_BUDGET`` — shared with ops.fused_likelihood: the
+  scoped-VMEM budget the tile estimates are held to.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from iwae_replication_project_tpu.ops.fused_likelihood import (
+    TILE_K,
+    _pad_axis,
+    _pixel_pad,
+    _vmem_budget,
+)
+from iwae_replication_project_tpu.utils.flops import largest_divisor_leq
+
+#: selection outcome -> the value of the ``kernel_path`` telemetry gauge
+#: (numeric so the gauge exports through JSONL/TB/Prometheus like any scalar)
+PATH_CODES = {"reference": 0, "blocked_scan": 1, "pallas": 2}
+
+#: default auto-threshold (bytes) for preferring the blocked scan over the
+#: materializing reference path off-TPU: the reference working set is
+#: ~k*B*(2*hid + pixels) floats (two hiddens + logits); past 256 MiB the
+#: one-shot composition starts to dominate host RSS on CPU eval chunks
+_SCAN_BYTES_DEFAULT = 256 * 1024 * 1024
+
+def _scan_threshold(on_tpu: bool) -> float:
+    env = os.environ.get("IWAE_HOT_LOOP_SCAN_BYTES")
+    if env:
+        return float(env)
+    return float("inf") if on_tpu else float(_SCAN_BYTES_DEFAULT)
+
+
+# --------------------------------------------------------------------------
+# Telemetry: which path ran (PR-4 registry)
+# --------------------------------------------------------------------------
+
+def _record_path(path: str) -> None:
+    from iwae_replication_project_tpu.telemetry.registry import get_registry
+    reg = get_registry()
+    reg.counter(f"kernel_path/{path}").inc()
+    reg.gauge("kernel_path").set(float(PATH_CODES[path]))
+
+
+def selected_path_code() -> float:
+    """Last selection recorded on the default registry (the gauge value).
+
+    Last-write-wins across every shape the process traces — fine for a live
+    gauge, WRONG for stamping rows (a jit-cache hit traces nothing, so the
+    gauge may describe some other program). Rows stamp
+    :func:`path_code_for_model` instead, which recomputes the deterministic
+    selection for the row's own shape.
+    """
+    from iwae_replication_project_tpu.telemetry.registry import get_registry
+    return get_registry().gauge("kernel_path").value
+
+
+def path_code_for_model(cfg, k: int, batch: int, *, on_tpu: bool) -> float:
+    """The PATH_CODES code :func:`decoder_score` selects for one model shape.
+
+    Selection is a pure function of (shape, env, VMEM budget) with probe
+    results cached, so recomputing it here matches what a trace of the same
+    shape bakes in — without depending on trace ORDER the way the
+    ``kernel_path`` gauge does (a jit-cache-hit dispatch traces nothing and
+    would otherwise stamp whichever unrelated program traced last).
+    `cfg` is duck-typed on the ModelConfig fields (ops/ must not import
+    models/).
+    """
+    if not getattr(cfg, "fused_likelihood", False):
+        return float(PATH_CODES["reference"])
+    L = len(cfg.n_hidden_enc)
+    h1_dim = cfg.n_latent_dec[-2] if L >= 2 else cfg.n_latent_enc[-1]
+    cd = cfg.matmul_dtype
+    path, _ = select_path(k, batch, h1_dim, cfg.n_hidden_dec[-1], cfg.x_dim,
+                          on_tpu=on_tpu,
+                          compute_dtype=None if cd is None
+                          else jnp.dtype(cd).name)
+    return float(PATH_CODES[path])
+
+
+def path_counters() -> dict:
+    """``{path: times selected}`` — bench/serving stamp this into their rows."""
+    from iwae_replication_project_tpu.telemetry.registry import get_registry
+    snap = get_registry().snapshot()["counters"]
+    return {name.split("/", 1)[1]: int(v) for name, v in snap.items()
+            if name.startswith("kernel_path/")}
+
+
+# --------------------------------------------------------------------------
+# VMEM accounting + tile selection
+# --------------------------------------------------------------------------
+
+def fits_vmem_block(tk: int, tb: int, h1_dim: int, hid: int, n_pixels: int,
+                    grad: bool = False) -> bool:
+    """Whether one (tk, tb) program of the 3-matmul kernel fits scoped VMEM.
+
+    Counts the peak-live f32 tiles (operands stream in f32 today — see the
+    itemsize note in ops.fused_likelihood.fits_vmem): the h tile, the y1/y2
+    hiddens, the logits tile plus the broadcast x rows, and — under
+    ``grad`` — the dlogits/dy tiles, the dh output, and the full dW/db
+    accumulators. Deliberately conservative; :func:`kernel_usable_block`
+    adds the probe-compile safety net for shapes this formula mispredicts.
+    """
+    p_pad = _pixel_pad(n_pixels)
+    rows = tk * tb
+    weights = h1_dim * hid + hid * hid + hid * p_pad + 2 * hid + p_pad
+    if grad:
+        # live tiles: h, dh (2*h1) + y1, y2, dy1, dy2 (4*hid)
+        #             + logits, dlogits, x_rows (3*p_pad) + g
+        est = 4 * (rows * (2 * h1_dim + 4 * hid + 3 * p_pad + 1)
+                   + 2 * weights + tb * p_pad)
+    else:
+        # live tiles: h + y1, y2 + logits, x_rows + out
+        est = 4 * (rows * (h1_dim + 2 * hid + 2 * p_pad + 1)
+                   + weights + tb * p_pad)
+    return est <= _vmem_budget()
+
+
+def select_block(k: int, b: int, h1_dim: int, hid: int, n_pixels: int,
+                 grad: bool = False) -> Optional[Tuple[int, int]]:
+    """Largest (tk, tb) tile whose working set fits, or None.
+
+    tk is the sublane dim of the ``[k, B]`` out tile -> multiples of 8 (or
+    all of k when k < 8). tb is its LANE dim -> either the full batch (any
+    size, Mosaic's full-dim exemption) or a multiple of 128; candidates run
+    largest-first so the grid stays as coarse as the budget allows.
+    """
+    tk = min(TILE_K, k)
+    # tb is the LANE dim of the [k, B] out/g tiles: a partial batch tile
+    # must be a multiple of 128; the full batch may be any size (Mosaic's
+    # full-dim exemption — the same rule the k-only predecessor leaned on).
+    # The full batch (zero padding) goes first; partial tiles rank by TOTAL
+    # padded rows, then by coarseness — a 384 tile that pads b=420 to 768
+    # must lose to a 256 tile padding to 512 (padded rows are computed and
+    # thrown away), not win on raw tile size.
+    partial = sorted((m for m in (512, 384, 256, 128) if m < b),
+                     key=lambda m: (b + (-b) % m, -m))
+    for tb in [b] + partial:
+        if fits_vmem_block(tk, tb, h1_dim, hid, n_pixels, grad=grad):
+            return tk, tb
+    return None
+
+
+_probe_cache: dict = {}
+
+
+def kernel_usable_block(k: int, b: int, h1_dim: int, hid: int, n_pixels: int,
+                        *, grad: bool = False, interpret: bool = False,
+                        compute_dtype=None) -> Optional[Tuple[int, int]]:
+    """The production gate: tile estimate + one probe compile per shape.
+
+    Returns the chosen (tk, tb) when the kernel is usable, else None. Same
+    contract as ops.fused_likelihood.kernel_usable: a shape that passes the
+    estimate but fails to compile (another chip generation, a Mosaic layout
+    limit) warns once and permanently selects the fallback — never crashes
+    the enclosing jit. Interpret mode (CPU tests) has no scoped-VMEM limit,
+    so the estimate alone decides. The probe cache is keyed on the effective
+    budget so a mid-process ``IWAE_FUSED_VMEM_BUDGET`` change re-probes.
+    """
+    block = select_block(k, b, h1_dim, hid, n_pixels, grad=grad)
+    if block is None:
+        return None
+    if interpret:
+        return block
+    key = (k, b, h1_dim, hid, n_pixels, grad, str(compute_dtype), block,
+           _vmem_budget())
+    hit = _probe_cache.get(key)
+    if hit is None:
+        hit = _probe_compiles(k, b, h1_dim, hid, n_pixels, grad,
+                              compute_dtype, block)
+        _probe_cache[key] = hit
+    return block if hit else None
+
+
+def _probe_compiles(k, b, h1_dim, hid, n_pixels, grad, compute_dtype,
+                    block) -> bool:
+    import warnings
+    s = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    args = (s((k, b, h1_dim), f32), s((h1_dim, hid), f32), s((hid,), f32),
+            s((hid, hid), f32), s((hid,), f32), s((hid, n_pixels), f32),
+            s((n_pixels,), f32), s((b, n_pixels), f32))
+    tk, tb = block
+    if grad:
+        fn = functools.partial(_bwd_pallas, tk=tk, tb=tb, interpret=False,
+                               compute_dtype=compute_dtype)
+        args = args + (s((k, b), f32),)
+    else:
+        fn = functools.partial(_fwd_pallas, tk=tk, tb=tb, interpret=False,
+                               compute_dtype=compute_dtype)
+    try:
+        jax.jit(fn).lower(*args).compile()
+        return True
+    except Exception as e:  # scoped-vmem overflow, Mosaic layout limits, ...
+        warnings.warn(
+            f"hot-loop kernel failed to compile for shape k={k} b={b} "
+            f"h1={h1_dim} hid={hid} d={n_pixels} grad={grad} tile={block} "
+            f"on {jax.devices()[0].device_kind!r}; selecting the fallback "
+            f"path for this shape ({type(e).__name__}: {str(e)[:200]})",
+            RuntimeWarning, stacklevel=3)
+        return False
+
+
+# --------------------------------------------------------------------------
+# The blocked Pallas kernels
+# --------------------------------------------------------------------------
+
+def _maybe_cast(a, compute_dtype):
+    return a if compute_dtype is None else a.astype(compute_dtype)
+
+
+def _fwd_kernel(h_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref, b3_ref, x_ref,
+                out_ref, *, n_pixels: int, p_pad: int, compute_dtype):
+    tk, tb, h1_dim = h_ref.shape
+    hid = w1_ref.shape[1]
+    cast = functools.partial(_maybe_cast, compute_dtype=compute_dtype)
+    h2d = h_ref[:].reshape(tk * tb, h1_dim)
+    y1 = jnp.tanh(jnp.dot(cast(h2d), cast(w1_ref[:]),
+                          preferred_element_type=jnp.float32) + b1_ref[:])
+    y2 = jnp.tanh(jnp.dot(cast(y1), cast(w2_ref[:]),
+                          preferred_element_type=jnp.float32) + b2_ref[:])
+    logits = jnp.dot(cast(y2), cast(w3_ref[:]),
+                     preferred_element_type=jnp.float32) + b3_ref[:]
+    x_rows = jnp.broadcast_to(x_ref[:][None],
+                              (tk, tb, p_pad)).reshape(tk * tb, p_pad)
+    ll = x_rows * logits - jax.nn.softplus(logits)
+    mask = lax.broadcasted_iota(jnp.int32, (1, p_pad), 1) < n_pixels
+    out_ref[:] = jnp.sum(jnp.where(mask, ll, 0.0), axis=-1).reshape(tk, tb)
+
+
+def _prep(h1, w3, b3, x, tk, tb):
+    """Pad (k, batch, pixels) up to the tile grid; weights w1/w2/b1/b2 need
+    no padding (their dims are full block dims)."""
+    p_pad = _pixel_pad(w3.shape[-1])
+    h1_p = _pad_axis(_pad_axis(h1, 0, tk), 1, tb)
+    return (h1_p, _pad_axis(w3, 1, p_pad), _pad_axis(b3, 0, p_pad)[None],
+            _pad_axis(_pad_axis(x, 0, tb), 1, p_pad), p_pad)
+
+
+def _fwd_pallas(h1, w1, b1, w2, b2, w3, b3, x, *, tk: int, tb: int,
+                interpret: bool, compute_dtype=None) -> jnp.ndarray:
+    k, b, h1_dim = h1.shape
+    hid = w1.shape[1]
+    n_pixels = w3.shape[-1]
+    h1_p, w3_p, b3_p, x_p, p_pad = _prep(h1, w3, b3, x, tk, tb)
+    kp, bp = h1_p.shape[0], h1_p.shape[1]
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, n_pixels=n_pixels, p_pad=p_pad,
+                          compute_dtype=compute_dtype),
+        out_shape=jax.ShapeDtypeStruct((kp, bp), jnp.float32),
+        grid=(kp // tk, bp // tb),
+        in_specs=[
+            pl.BlockSpec((tk, tb, h1_dim), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((h1_dim, hid), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hid), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hid, hid), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hid), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hid, p_pad), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, p_pad), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tb, p_pad), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tk, tb), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(h1_p, w1, b1[None], w2, b2[None], w3_p, b3_p, x_p)
+    return out[:k, :b]
+
+
+def _bwd_kernel(h_ref, w1_ref, b1_ref, w2_ref, b2_ref, w3_ref, b3_ref, x_ref,
+                g_ref, dh_ref, dw1_ref, db1_ref, dw2_ref, db2_ref, dw3_ref,
+                db3_ref, *, n_pixels: int, p_pad: int, compute_dtype):
+    """Tile-local recompute backward. Padded k/batch rows carry zero
+    cotangent (g is zero-padded), so their dlogits vanish and every dW/db
+    accumulation stays exact; padded pixels are masked out of dlogits."""
+    i, j = pl.program_id(0), pl.program_id(1)
+    tk, tb, h1_dim = h_ref.shape
+    cast = functools.partial(_maybe_cast, compute_dtype=compute_dtype)
+    h2d = h_ref[:].reshape(tk * tb, h1_dim)
+    y1 = jnp.tanh(jnp.dot(cast(h2d), cast(w1_ref[:]),
+                          preferred_element_type=jnp.float32) + b1_ref[:])
+    y2 = jnp.tanh(jnp.dot(cast(y1), cast(w2_ref[:]),
+                          preferred_element_type=jnp.float32) + b2_ref[:])
+    logits = jnp.dot(cast(y2), cast(w3_ref[:]),
+                     preferred_element_type=jnp.float32) + b3_ref[:]
+    x_rows = jnp.broadcast_to(x_ref[:][None],
+                              (tk, tb, p_pad)).reshape(tk * tb, p_pad)
+    mask = lax.broadcasted_iota(jnp.int32, (1, p_pad), 1) < n_pixels
+    # broadcast-then-collapse instead of reshape-to-[N,1] (Mosaic layout limit)
+    g_rows = jnp.broadcast_to(g_ref[:][:, :, None],
+                              (tk, tb, p_pad)).reshape(tk * tb, p_pad)
+    dlogits = jnp.where(mask, g_rows * (x_rows - jax.nn.sigmoid(logits)), 0.0)
+    dy2 = jnp.dot(cast(dlogits), cast(w3_ref[:]).T,
+                  preferred_element_type=jnp.float32) * (1.0 - y2 * y2)
+    dy1 = jnp.dot(cast(dy2), cast(w2_ref[:]).T,
+                  preferred_element_type=jnp.float32) * (1.0 - y1 * y1)
+    dh_ref[:] = jnp.dot(cast(dy1), cast(w1_ref[:]).T,
+                        preferred_element_type=jnp.float32
+                        ).reshape(tk, tb, h1_dim)
+
+    @pl.when((i == 0) & (j == 0))
+    def _():
+        dw1_ref[:] = jnp.zeros_like(dw1_ref)
+        db1_ref[:] = jnp.zeros_like(db1_ref)
+        dw2_ref[:] = jnp.zeros_like(dw2_ref)
+        db2_ref[:] = jnp.zeros_like(db2_ref)
+        dw3_ref[:] = jnp.zeros_like(dw3_ref)
+        db3_ref[:] = jnp.zeros_like(db3_ref)
+
+    dw3_ref[:] += jnp.dot(cast(y2).T, cast(dlogits),
+                          preferred_element_type=jnp.float32)
+    db3_ref[:] += jnp.sum(dlogits, axis=0, keepdims=True)
+    dw2_ref[:] += jnp.dot(cast(y1).T, cast(dy2),
+                          preferred_element_type=jnp.float32)
+    db2_ref[:] += jnp.sum(dy2, axis=0, keepdims=True)
+    dw1_ref[:] += jnp.dot(cast(h2d).T, cast(dy1),
+                          preferred_element_type=jnp.float32)
+    db1_ref[:] += jnp.sum(dy1, axis=0, keepdims=True)
+
+
+def _bwd_pallas(h1, w1, b1, w2, b2, w3, b3, x, g, *, tk: int, tb: int,
+                interpret: bool, compute_dtype=None):
+    k, b, h1_dim = h1.shape
+    hid = w1.shape[1]
+    n_pixels = w3.shape[-1]
+    h1_p, w3_p, b3_p, x_p, p_pad = _prep(h1, w3, b3, x, tk, tb)
+    kp, bp = h1_p.shape[0], h1_p.shape[1]
+    g_p = _pad_axis(_pad_axis(g, 0, tk), 1, tb)
+    wspec = lambda d0, d1: pl.BlockSpec((d0, d1), lambda i, j: (0, 0),
+                                        memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        functools.partial(_bwd_kernel, n_pixels=n_pixels, p_pad=p_pad,
+                          compute_dtype=compute_dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((kp, bp, h1_dim), jnp.float32),
+            jax.ShapeDtypeStruct((h1_dim, hid), jnp.float32),
+            jax.ShapeDtypeStruct((1, hid), jnp.float32),
+            jax.ShapeDtypeStruct((hid, hid), jnp.float32),
+            jax.ShapeDtypeStruct((1, hid), jnp.float32),
+            jax.ShapeDtypeStruct((hid, p_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, p_pad), jnp.float32),
+        ),
+        grid=(kp // tk, bp // tb),
+        in_specs=[
+            pl.BlockSpec((tk, tb, h1_dim), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            wspec(h1_dim, hid), wspec(1, hid), wspec(hid, hid), wspec(1, hid),
+            wspec(hid, p_pad), wspec(1, p_pad),
+            pl.BlockSpec((tb, p_pad), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tk, tb), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((tk, tb, h1_dim), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            wspec(h1_dim, hid), wspec(1, hid), wspec(hid, hid), wspec(1, hid),
+            wspec(hid, p_pad), wspec(1, p_pad),
+        ),
+        interpret=interpret,
+    )(h1_p, w1, b1[None], w2, b2[None], w3_p, b3_p, x_p, g_p)
+    dh, dw1, db1, dw2, db2, dw3, db3 = outs
+    return (dh[:k, :b], dw1, db1[0], dw2, db2[0],
+            dw3[:, :n_pixels], db3[0, :n_pixels])
+
+
+# --------------------------------------------------------------------------
+# Reference composition + blocked-scan fallback (identical math)
+# --------------------------------------------------------------------------
+
+def _dense(x, w, b, compute_dtype):
+    """mlp.dense_apply's exact op sequence (re-stated locally: ops/ must not
+    import models/) — bf16 operand casts with f32 accumulation when asked."""
+    if compute_dtype is not None:
+        y = jnp.dot(x.astype(compute_dtype), w.astype(compute_dtype),
+                    preferred_element_type=jnp.float32)
+    else:
+        y = jnp.dot(x, w)
+    return y + b
+
+
+def _reference_impl(h1, w1, b1, w2, b2, w3, b3, x, compute_dtype=None):
+    """Unfused XLA composition — the fallback tail and the parity oracle.
+
+    Op-for-op the same sequence as models.mlp.output_block_apply followed by
+    the logits-form Bernoulli reduction, so selecting ``reference`` is
+    bitwise-identical to the pre-hot-loop unfused path.
+    """
+    y1 = jnp.tanh(_dense(h1, w1, b1, compute_dtype))
+    y2 = jnp.tanh(_dense(y1, w2, b2, compute_dtype))
+    logits = _dense(y2, w3, b3, compute_dtype).astype(jnp.float32)
+    ll = x[None] * logits - jax.nn.softplus(logits)
+    return jnp.sum(ll, axis=-1)
+
+
+def _blocked_scan_impl(h1, w1, b1, w2, b2, w3, b3, x, *, block_k: int,
+                       compute_dtype=None):
+    """Hand-blocked scan over k-slabs with per-slab remat.
+
+    Each slab runs the identical per-row math as :func:`_reference_impl`
+    under ``jax.checkpoint``: the forward holds one ``[bk, B, 784]`` logits
+    slab at a time and the backward recomputes it, mirroring the kernel's
+    tile-local-recompute policy in plain XLA. Per-row results are the same
+    dot products over the same operands, so slab blocking changes memory,
+    not values.
+    """
+    k = h1.shape[0]
+    bk = largest_divisor_leq(k, max(block_k, 1))
+
+    @jax.checkpoint
+    def slab(h_slab):
+        return _reference_impl(h_slab, w1, b1, w2, b2, w3, b3, x,
+                               compute_dtype)
+
+    if bk == k:
+        return slab(h1)
+    out = lax.map(slab, h1.reshape(k // bk, bk, *h1.shape[1:]))
+    return out.reshape(k, h1.shape[1])
+
+
+def _scan_block_k(k: int, b: int, hid: int, n_pixels: int) -> int:
+    """Slab height targeting ~32 MiB of slab activations: big enough to keep
+    the matmuls efficient, small enough that remat actually bounds memory."""
+    per_k = b * (2 * hid + n_pixels) * 4
+    return max(1, min(k, (32 * 1024 * 1024) // max(per_k, 1)))
+
+
+# --------------------------------------------------------------------------
+# Custom VJP over the pallas forward (backward tile chosen independently)
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11))
+def _fused_block_ll(h1, w1, b1, w2, b2, w3, b3, x, tk, tb, interpret,
+                    compute_dtype):
+    return _fused_fwd(h1, w1, b1, w2, b2, w3, b3, x, tk, tb, interpret,
+                      compute_dtype)[0]
+
+
+def _fused_fwd(h1, w1, b1, w2, b2, w3, b3, x, tk, tb, interpret,
+               compute_dtype):
+    out = _fwd_pallas(h1, w1, b1, w2, b2, w3, b3, x, tk=tk, tb=tb,
+                      interpret=interpret, compute_dtype=compute_dtype)
+    return out, (h1, w1, b1, w2, b2, w3, b3, x)
+
+
+def _bwd_reference(h1, w1, b1, w2, b2, w3, b3, x, g, compute_dtype):
+    """XLA backward of the same composition (the over-budget fallback)."""
+    def f(h1_, w1_, b1_, w2_, b2_, w3_, b3_):
+        return _reference_impl(h1_, w1_, b1_, w2_, b2_, w3_, b3_, x,
+                               compute_dtype)
+
+    _, vjp = jax.vjp(f, h1, w1, b1, w2, b2, w3, b3)
+    return vjp(g)
+
+
+def _fused_bwd(tk, tb, interpret, compute_dtype, res, g):
+    h1, w1, b1, w2, b2, w3, b3, x = res
+    k, b, h1_dim = h1.shape
+    block = kernel_usable_block(k, b, h1_dim, w1.shape[1], w3.shape[-1],
+                                grad=True, interpret=interpret,
+                                compute_dtype=compute_dtype)
+    if block is not None:
+        grads = _bwd_pallas(h1, w1, b1, w2, b2, w3, b3, x, g,
+                            tk=block[0], tb=block[1], interpret=interpret,
+                            compute_dtype=compute_dtype)
+    else:
+        # backward working set over the scoped-VMEM budget: keep the fused
+        # forward, let XLA schedule the backward (materializes logits once)
+        grads = _bwd_reference(h1, w1, b1, w2, b2, w3, b3, x, g,
+                               compute_dtype)
+    return grads + (None,)  # no gradient for the binary targets
+
+
+_fused_block_ll.defvjp(_fused_fwd, _fused_bwd)
+
+
+# --------------------------------------------------------------------------
+# Selection + the public entry point
+# --------------------------------------------------------------------------
+
+def select_path(k: int, b: int, h1_dim: int, hid: int, n_pixels: int, *,
+                on_tpu: bool, compute_dtype=None
+                ) -> Tuple[str, Optional[Tuple[int, int]]]:
+    """``(path, pallas_block_or_None)`` for one hot-loop shape.
+
+    Order: env override > Pallas (probe-gated; interpret mode only when
+    forced, so CPU production never pays the interpreter) > blocked scan
+    when the materialized working set crosses the threshold > reference.
+    Runs at trace time only — the choice is baked into the compiled program,
+    so it can never cause a mid-run recompile.
+    """
+    from iwae_replication_project_tpu.telemetry.spans import span
+
+    forced = os.environ.get("IWAE_HOT_LOOP_PATH", "auto").lower()
+    if forced not in ("auto", "pallas", "blocked_scan", "reference"):
+        raise ValueError(
+            f"IWAE_HOT_LOOP_PATH={forced!r}: expected auto | pallas | "
+            f"blocked_scan | reference")
+    if forced == "pallas" or (forced == "auto" and on_tpu):
+        with span("kernel/select/pallas"):
+            block = kernel_usable_block(k, b, h1_dim, hid, n_pixels,
+                                        grad=False, interpret=not on_tpu,
+                                        compute_dtype=compute_dtype)
+        if block is not None:
+            return "pallas", block
+        if forced == "pallas":
+            import warnings
+            warnings.warn(
+                f"IWAE_HOT_LOOP_PATH=pallas but no tile fits shape "
+                f"k={k} b={b} h1={h1_dim} hid={hid} d={n_pixels}; "
+                f"using blocked_scan", RuntimeWarning, stacklevel=2)
+            return "blocked_scan", None
+    if forced == "blocked_scan":
+        return "blocked_scan", None
+    if forced == "reference":
+        return "reference", None
+    workset = 4.0 * k * b * (2 * hid + n_pixels)
+    if workset > _scan_threshold(on_tpu):
+        return "blocked_scan", None
+    return "reference", None
+
+
+def decoder_score(out_params, x, h1, *, compute_dtype=None,
+                  on_tpu: bool = False) -> jnp.ndarray:
+    """``log p(x | h1)`` summed over pixels -> ``[k, B]``, hot-loop-blocked.
+
+    `out_params` is the models.mlp output block pytree (``l1``/``l2``/``out``
+    dense layers); `x` is ``[B, D]`` binary targets, `h1` the ``[k, B, H1]``
+    bottom latent. The decoder intermediates (two ``[k, B, hid]`` hiddens
+    and the ``[k, B, D]`` logits) never materialize at full k on the pallas
+    and blocked-scan paths. Selection happens here, at trace time, and is
+    recorded on the telemetry registry.
+    """
+    w1, b1 = out_params["l1"]["w"], out_params["l1"]["b"]
+    w2, b2 = out_params["l2"]["w"], out_params["l2"]["b"]
+    w3, b3 = out_params["out"]["w"], out_params["out"]["b"]
+    k, b, h1_dim = h1.shape
+    hid = w1.shape[1]
+    n_pixels = w3.shape[-1]
+    cd = None if compute_dtype is None else jnp.dtype(compute_dtype).name
+    path, block = select_path(k, b, h1_dim, hid, n_pixels, on_tpu=on_tpu,
+                              compute_dtype=cd)
+    _record_path(path)
+    if path == "pallas":
+        return _fused_block_ll(h1, w1, b1, w2, b2, w3, b3, x,
+                               block[0], block[1], not on_tpu, cd)
+    if path == "blocked_scan":
+        return _blocked_scan_impl(h1, w1, b1, w2, b2, w3, b3, x,
+                                  block_k=_scan_block_k(k, b, hid, n_pixels),
+                                  compute_dtype=cd)
+    return _reference_impl(h1, w1, b1, w2, b2, w3, b3, x, cd)

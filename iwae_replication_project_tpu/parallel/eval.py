@@ -349,6 +349,13 @@ def parallel_training_statistics(params, cfg: model.ModelConfig, mesh,
     # make_parallel_dataset_scalars) — the eval-RNG version stamp
     acc["nll_chunk"] = float(largest_divisor_leq(nll_k // n_sp, nll_chunk))
     acc["eval_batch"] = float(batch_size)
+    # which hot-loop path the chunked NLL scorer selects at the PER-DEVICE
+    # shape of this row (chunk x local batch) — recomputed per config, never
+    # read from trace-order state (ops/hot_loop.PATH_CODES)
+    from iwae_replication_project_tpu.ops.hot_loop import path_code_for_model
+    acc["kernel_path"] = path_code_for_model(
+        cfg, int(acc["nll_chunk"]), batch_size // n_dp,
+        on_tpu=model._on_tpu())
 
     res2: Dict[str, object] = {}
     k_au, k_pruned = jax.random.split(jax.random.fold_in(key, n_batches))

@@ -121,8 +121,12 @@ class ServingEngine:
             raise ValueError("pass a model, a checkpoint directory, or "
                              "params= + model_config=")
         # serving batches are small and vmapped per-row; the Pallas fused
-        # path is shaped for the big eval batches and does not compose with
-        # the row-vmap, so serving programs always run the unfused kernels
+        # path is shaped for the big train/eval batches and vmapped Mosaic
+        # has not been validated on hardware, so serving programs pin the
+        # unfused composition (== the hot-loop dispatcher's reference path;
+        # the metrics `kernel_path` gauge reports the pin honestly). Lifting
+        # this needs a chip run of the row-vmapped kernel — tracked in
+        # ROADMAP item 4 follow-ups.
         self.cfg = dataclasses.replace(model_config, fused_likelihood=False)
         self.k = int(k) if k is not None else 50
         self.timeout_s = timeout_s
@@ -496,6 +500,15 @@ class ServingEngine:
                                  build_key=self._build_key(op, k, bucket))
                         n_programs += 1
         d = stats_delta(s0)
+        # record which hot-loop path this engine's programs run on THIS
+        # engine's registry (ops/hot_loop.PATH_CODES) — recomputed from the
+        # engine's own config at the per-row program shape, never read from
+        # trace-order state (a cache-warm warmup traces nothing)
+        from iwae_replication_project_tpu.ops.hot_loop import (
+            path_code_for_model)
+        from iwae_replication_project_tpu.models.iwae import _on_tpu
+        self.metrics.registry.gauge("kernel_path").set(
+            path_code_for_model(self.cfg, self.k, 1, on_tpu=_on_tpu()))
         return {"programs": float(n_programs),
                 "compiles": float(d["aot_misses"]),
                 "recompiles": float(d["persistent_cache_misses"]),

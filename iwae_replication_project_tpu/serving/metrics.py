@@ -119,6 +119,9 @@ class ServingMetrics:
             "counters": c,
             "queue_depth": int(snap["gauges"].get("queue_depth", 0)),
             "inflight": int(snap["gauges"].get("inflight", 0)),
+            # which hot-loop path the engine's programs traced with
+            # (ops/hot_loop.PATH_CODES; set by ServingEngine.warmup)
+            "kernel_path": int(snap["gauges"].get("kernel_path", 0)),
             "padding_waste": (c["padded_rows"] / rows) if rows else 0.0,
             "latency": section(_LAT),
             "queue_wait": section(_QW),
@@ -134,6 +137,7 @@ class ServingMetrics:
                                  for k, v in snap["counters"].items()}
         out["queue_depth"] = float(snap["queue_depth"])
         out["inflight"] = float(snap["inflight"])
+        out["kernel_path"] = float(snap["kernel_path"])
         out["padding_waste"] = float(snap["padding_waste"])
         for kind in ("latency", "queue_wait", "device_wait"):
             for name, s in snap[kind].items():

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from iwae_replication_project_tpu.models import iwae as model
-from iwae_replication_project_tpu.ops.logsumexp import logmeanexp
+from iwae_replication_project_tpu.objectives.estimators import iwae_per_example
 
 
 @partial(jax.jit, static_argnames=("cfg", "k"))
@@ -40,7 +40,7 @@ def score_rows(params, cfg: model.ModelConfig, base_key: jax.Array,
     def row(seed, xr):
         lw = model.log_weights(params, cfg, jax.random.fold_in(base_key, seed),
                                xr[None], k)          # [k, 1]
-        return logmeanexp(lw[:, 0], axis=0)
+        return iwae_per_example(lw)[0]
     return jax.vmap(row)(seeds, x)
 
 

@@ -80,6 +80,16 @@ def make_train_step_fn(spec: ObjectiveSpec, cfg: model.ModelConfig,
 def make_train_step(spec: ObjectiveSpec, cfg: model.ModelConfig,
                     optimizer: optax.GradientTransformation | None = None,
                     donate: bool = True):
-    """Build the jitted single-device step; see parallel.dp for the sharded one."""
+    """Build the jitted single-device step; see parallel.dp for the sharded one.
+
+    With ``cfg.fused_likelihood`` the step's log-weight pass runs through the
+    blocked hot-loop dispatcher (ops/hot_loop.py) — kernel selection happens
+    once at trace time and lands on the telemetry ``kernel_path`` gauge, so a
+    driver can stamp which path its compiled step uses. The step is wrapped
+    in a ``train/step`` span (the whole-epoch scan path has its own
+    ``train/epoch`` span in training/epoch.py).
+    """
+    from iwae_replication_project_tpu.telemetry.spans import spanned
     step = make_train_step_fn(spec, cfg, optimizer)
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return spanned(jax.jit(step, donate_argnums=(0,) if donate else ()),
+                   "train/step")

@@ -132,8 +132,9 @@ class ExperimentConfig:
     # (backends/jax_backend.py ctor) — intentional: the facade is the
     # reference-parity surface, this config is the production one.
     likelihood: str = "logits"
-    # Pallas fused decoder-matmul+Bernoulli-LL kernel (ops/fused_likelihood).
-    # None = auto: enabled on TPU when likelihood == "logits".
+    # blocked hot-loop dispatcher (ops/hot_loop): the decoder scoring block
+    # fused over (k, batch) tiles, with per-shape blocked-scan / unfused
+    # fallback. None = auto: enabled on TPU when likelihood == "logits".
     fused_likelihood: Optional[bool] = None
 
     # warm-path execution (utils/compile_cache.py). compile_cache_dir: None =

@@ -1,0 +1,117 @@
+"""Analytic matmul-FLOP accounting: the MFU roofline numerator + peak table.
+
+MFU is a first-class bench metric (ISSUE 6): every phase (train / eval /
+serving) reports ``achieved FLOP/s / peak chip FLOP/s`` with BOTH sides of
+the ratio recorded. The numerator is *analytic matmul FLOPs only* — counted
+from the model architecture (2 FLOPs per MAC, backward ~= 2x forward for
+dense stacks), never from a profiler — so it is an honest lower bound on
+work: elementwise ops, sampling, and reductions ride along for free, and a
+fused kernel cannot inflate its own MFU by doing more work. Until this
+module the formulas were hard-coded in bench.py for the flagship dims only;
+here they derive from any :class:`~..models.iwae.ModelConfig`, so the
+width-scaling sweep, the paper config, and future architectures share one
+accounting.
+
+The denominator comes from :func:`peak_flops_for_kind`: per-chip dense bf16
+peaks for the published TPU generations, matched against
+``jax.Device.device_kind``. Unknown chips get ``(None, reason)`` — the bench
+reports ``mfu: null`` with the reason stamped and a documented
+``--peak-flops`` / ``BENCH_PEAK_FLOPS`` override, never a fabricated
+denominator (ADVICE r2).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+#: per-chip dense bf16 peak FLOP/s by ``device_kind`` substring, matched in
+#: order (more specific first: "v5p" must win over "v5"). Sources: Google's
+#: published per-chip specs — v2 45T, v3 123T, v4 275T, v5e 197T, v5p 459T,
+#: v6e/Trillium 918T.
+PEAK_BF16_FLOPS: Tuple[Tuple[str, float], ...] = (
+    ("v6e", 918e12), ("v6 lite", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+)
+
+
+def peak_flops_for_kind(kind: str) -> Tuple[Optional[float], str]:
+    """``(peak_flops | None, source)`` for one ``device_kind`` string."""
+    low = kind.lower()
+    for sub, val in PEAK_BF16_FLOPS:
+        if sub in low:
+            return val, f"bf16 peak table: matched {sub!r} in device_kind {kind!r}"
+    return None, f"no peak-FLOPs table entry for device_kind {kind!r}"
+
+
+def largest_divisor_leq(n: int, cap: int) -> int:
+    """Largest divisor of `n` not exceeding `cap` — chunk/slab/tile sizing
+    shared by the eval drivers (evaluation/metrics, parallel/eval) and the
+    hot-loop blocked scan (ops/hot_loop). Homed here because ops/ cannot
+    import evaluation/ (layering: evaluation -> models -> ops)."""
+    return max(d for d in range(1, min(cap, n) + 1) if n % d == 0)
+
+
+def stochastic_block_macs(in_dim: int, hidden: int, latent: int) -> int:
+    """Matmul MACs of one stochastic block per row: 2 hidden + mu/std heads
+    (models.mlp.stochastic_block_apply)."""
+    return in_dim * hidden + hidden * hidden + 2 * hidden * latent
+
+
+def output_block_macs(in_dim: int, hidden: int, out_dim: int) -> int:
+    """Matmul MACs of the decoder output block per row: 2 hidden + logit
+    layer (models.mlp.output_block_apply) — the hot-loop kernel's region."""
+    return in_dim * hidden + hidden * hidden + hidden * out_dim
+
+
+def per_row_macs(cfg) -> Tuple[int, int]:
+    """``(macs_per_batch_row, macs_per_(k x batch)_row)`` for one forward.
+
+    The first encoder block runs before the k fan-out (no k axis); every
+    other block — encoder layers 2..L, the decoder stochastic chain, and the
+    output block — scales with k (models/iwae.py shape conventions).
+    """
+    L = cfg.n_stochastic
+    no_k = stochastic_block_macs(cfg.x_dim, cfg.n_hidden_enc[0],
+                                 cfg.n_latent_enc[0])
+    per_k = 0
+    in_dim = cfg.n_latent_enc[0]
+    for i in range(1, L):
+        per_k += stochastic_block_macs(in_dim, cfg.n_hidden_enc[i],
+                                       cfg.n_latent_enc[i])
+        in_dim = cfg.n_latent_enc[i]
+    in_dim = cfg.n_latent_enc[-1]
+    for i in range(L - 1):
+        per_k += stochastic_block_macs(in_dim, cfg.n_hidden_dec[i],
+                                       cfg.n_latent_dec[i])
+        in_dim = cfg.n_latent_dec[i]
+    per_k += output_block_macs(in_dim, cfg.n_hidden_dec[-1], cfg.x_dim)
+    return no_k, per_k
+
+
+def forward_flops(cfg, batch: int, k: int) -> float:
+    """Analytic matmul FLOPs of one log-weights forward (MACs * 2)."""
+    no_k, per_k = per_row_macs(cfg)
+    return 2.0 * (batch * no_k + batch * k * per_k)
+
+
+def train_step_flops(cfg, batch: int, k: int) -> float:
+    """Per optimizer step: forward + ~2x-forward backward for dense stacks."""
+    return 3.0 * forward_flops(cfg, batch, k)
+
+
+def eval_suite_flops_per_image(cfg, k: int, nll_k: int,
+                               nll_chunk: int) -> float:
+    """Per test image through evaluation.metrics.dataset_scalars: the k-sample
+    metric pass, the streaming nll_k-sample NLL (each chunk re-runs the
+    k-independent encoder layer), and the 1-sample reconstruction
+    (approximated as one k=1 forward). Forward-only — eval takes no grads.
+    """
+    no_k, per_k = per_row_macs(cfg)
+    nll = 2.0 * ((nll_k // nll_chunk) * no_k + nll_k * per_k)
+    return forward_flops(cfg, 1, k) + nll + forward_flops(cfg, 1, 1)
+
+
+def serving_score_flops_per_row(cfg, k: int) -> float:
+    """Per served ``score`` request: one k-sample forward (serving/programs)."""
+    return forward_flops(cfg, 1, k)

@@ -12,7 +12,11 @@
    path on a warm engine under a ragged burst: zero recompiles after
    warmup, zero lost futures through a mid-burst ``stop()``, in-flight
    window drained;
-4. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with ``--sanitize``
+4. **hot-loop smoke** (scripts/hot_loop_smoke.py) — interpret-mode parity
+   of the blocked (k, batch) kernel (fwd + grads), bitwise blocked-scan
+   fallback, forced-path dispatch parity with kernel_path telemetry, and
+   the one-probe-per-shape cache;
+5. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with ``--sanitize``
    armed, so the marked subset additionally runs under
    ``jax.transfer_guard("disallow")`` + ``jax.debug_nans``. The serving
    subsystem's fast tests (tests/test_serving.py: batcher policy,
@@ -62,6 +66,15 @@ def run_serving_smoke() -> int:
         cwd=REPO, env=env)
 
 
+def run_hot_loop_smoke() -> int:
+    print("== hot-loop smoke: blocked kernel parity + probe cache ".ljust(72, "="))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.call(
+        [sys.executable, os.path.join("scripts", "hot_loop_smoke.py")],
+        cwd=REPO, env=env)
+
+
 def run_tests(extra) -> int:
     print("== pytest: tier-1 (fast profile) + sanitizers ".ljust(72, "="))
     env = dict(os.environ)
@@ -89,6 +102,7 @@ def main(argv=None) -> int:
     # keep their single-stage contract
     rc_smoke = 0 if single_stage else run_telemetry_smoke()
     rc_serve = 0 if single_stage else run_serving_smoke()
+    rc_hot = 0 if single_stage else run_hot_loop_smoke()
     rc_tests = 0 if args.lint_only else run_tests(passthrough)
 
     print("== check summary ".ljust(72, "="))
@@ -97,9 +111,10 @@ def main(argv=None) -> int:
     if not single_stage:
         print(f"smoke: {'ok' if rc_smoke == 0 else f'FAILED (rc={rc_smoke})'}")
         print(f"serve: {'ok' if rc_serve == 0 else f'FAILED (rc={rc_serve})'}")
+        print(f"hot  : {'ok' if rc_hot == 0 else f'FAILED (rc={rc_hot})'}")
     if not args.lint_only:
         print(f"tests: {'ok' if rc_tests == 0 else f'FAILED (rc={rc_tests})'}")
-    return 1 if (rc_lint or rc_smoke or rc_serve or rc_tests) else 0
+    return 1 if (rc_lint or rc_smoke or rc_serve or rc_hot or rc_tests) else 0
 
 
 if __name__ == "__main__":

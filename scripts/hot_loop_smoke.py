@@ -1,0 +1,127 @@
+"""Hot-loop smoke stage for scripts/check.py: kernel parity + probe cache.
+
+Exercises, in one short CPU process (``JAX_PLATFORMS=cpu``):
+
+1. interpret-mode parity of the blocked (k, batch) Pallas kernel against the
+   reference composition — forward and custom-VJP grads — on an odd shape
+   (non-multiple-of-8 k, partial 128-batch tile, ragged pixel dim);
+2. bitwise equality of the blocked-scan fallback's forward;
+3. the model-level dispatch: ``log_weights`` under every forced
+   ``IWAE_HOT_LOOP_PATH`` agrees bitwise with the unfused config, and the
+   selection lands on the ``kernel_path`` telemetry gauge/counters;
+4. the probe cache: a second ``kernel_usable_block`` query for the same
+   shape must NOT re-probe (one compile probe per shape per budget — the
+   lever that keeps trace-time selection free of repeated XLA work).
+
+Exit 0 on success, 1 with a message on the first failed check.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        setup_persistent_cache)
+
+    # warm-path discipline, like every entry point: repeated CI runs of the
+    # jitted parity programs below should hit the persistent cache
+    setup_persistent_cache(base_dir=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from iwae_replication_project_tpu.models import (
+        ModelConfig, init_params, log_weights)
+    from iwae_replication_project_tpu.ops import hot_loop as hl
+
+    rs = np.random.RandomState(0)
+    k, b, h1d, hid, d = 10, 150, 8, 16, 130
+    args = (jnp.asarray(rs.randn(k, b, h1d).astype(np.float32)),
+            jnp.asarray(rs.randn(h1d, hid).astype(np.float32) * 0.2),
+            jnp.asarray(rs.randn(hid).astype(np.float32) * 0.1),
+            jnp.asarray(rs.randn(hid, hid).astype(np.float32) * 0.2),
+            jnp.asarray(rs.randn(hid).astype(np.float32) * 0.1),
+            jnp.asarray(rs.randn(hid, d).astype(np.float32) * 0.2),
+            jnp.asarray(rs.randn(d).astype(np.float32) * 0.1),
+            jnp.asarray((rs.rand(b, d) > 0.5).astype(np.float32)))
+
+    # 1) interpret-mode kernel parity (fwd + grads), partial batch tile
+    want = hl._reference_impl(*args)
+    got = hl._fwd_pallas(*args, tk=8, tb=128, interpret=True)
+    assert np.allclose(np.asarray(got), np.asarray(want), rtol=1e-5,
+                       atol=1e-4), "pallas fwd parity"
+    x = args[-1]
+
+    def loss_f(*ps):
+        return jnp.sum(hl._fused_block_ll(*ps, x, 8, 128, True, None) ** 2)
+
+    def loss_r(*ps):
+        return jnp.sum(hl._reference_impl(*ps, x) ** 2)
+
+    g_f = jax.grad(loss_f, argnums=(0, 1, 6))(*args[:-1])
+    g_r = jax.grad(loss_r, argnums=(0, 1, 6))(*args[:-1])
+    for a, w in zip(g_f, g_r):
+        assert np.allclose(np.asarray(a), np.asarray(w), rtol=1e-4,
+                           atol=1e-4), "pallas bwd parity"
+
+    # 2) blocked-scan fallback: bitwise forward
+    got_bs = hl._blocked_scan_impl(*args, block_k=4)
+    assert np.array_equal(np.asarray(got_bs), np.asarray(want)), \
+        "blocked-scan bitwise parity"
+
+    # 3) model-level dispatch parity + telemetry
+    cfg_f = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                        n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12,
+                        likelihood="logits", fused_likelihood=True)
+    cfg_p = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                        n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12,
+                        likelihood="logits")
+    params = init_params(jax.random.PRNGKey(0), cfg_p)
+    xb = (jax.random.uniform(jax.random.PRNGKey(1), (6, 12)) > 0.5
+          ).astype(jnp.float32)
+    key = jax.random.PRNGKey(2)
+    lw_ref = log_weights(params, cfg_p, key, xb, k=4)
+    for path in ("reference", "blocked_scan", "pallas"):
+        os.environ["IWAE_HOT_LOOP_PATH"] = path
+        lw = log_weights(params, cfg_f, key, xb, k=4)  # iwaelint: disable=key-reuse -- parity check deliberately replays the IDENTICAL key per path; only the dispatch route may differ
+        assert np.array_equal(np.asarray(lw), np.asarray(lw_ref)), \
+            f"dispatch parity under {path}"
+        assert hl.selected_path_code() == float(hl.PATH_CODES[path]), \
+            f"kernel_path gauge under {path}"
+    os.environ.pop("IWAE_HOT_LOOP_PATH", None)
+    counters = hl.path_counters()
+    assert counters.get("pallas", 0) >= 1 and \
+        counters.get("blocked_scan", 0) >= 1, counters
+
+    # 4) probe-cache hit: the second identical query must not re-probe
+    probes = []
+    real_probe = hl._probe_compiles
+    hl._probe_compiles = lambda *a, **kw: probes.append(a) or True
+    try:
+        hl._probe_cache.clear()
+        assert hl.kernel_usable_block(8, 4, 8, 16, 12,
+                                      interpret=False) is not None
+        assert hl.kernel_usable_block(8, 4, 8, 16, 12,
+                                      interpret=False) is not None
+        assert len(probes) == 1, f"probe cache missed: {len(probes)} probes"
+    finally:
+        hl._probe_compiles = real_probe
+        hl._probe_cache.clear()
+
+    print("hot-loop smoke: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"hot-loop smoke FAILED: {e}")
+        sys.exit(1)

@@ -65,6 +65,43 @@ class TestKernelParity:
             np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                        rtol=1e-5, atol=1e-5, err_msg=f"k={k}")
 
+    @pytest.mark.parametrize("k", (1, 3, 7, 17))
+    @pytest.mark.parametrize("b", (1, 3, 7, 17))
+    def test_masked_tiles_odd_shapes_fwd_bwd(self, k, b):
+        """Satellite (ISSUE 6): the _pixel_pad/_pad_axis zero padding must be
+        invisible at every odd k/batch size and a non-multiple-of-128 pixel
+        dim — forward AND backward against _reference_impl, plus the output
+        fed through a logsumexp reduction (a padded row leaking into the
+        ``exp`` sum would shift the bound even when the slice looks right).
+        """
+        rs = np.random.RandomState(k * 100 + b)
+        h, d = 16, 130  # 130 pixels: one full 128-lane block + a ragged tail
+        h1 = jnp.asarray(rs.randn(k, b, h).astype(np.float32))
+        w = jnp.asarray(rs.randn(h, d).astype(np.float32) * 0.2)
+        bias = jnp.asarray(rs.randn(d).astype(np.float32) * 0.1)
+        x = jnp.asarray((rs.rand(b, d) > 0.5).astype(np.float32))
+        got = fused_bernoulli_ll(h1, w, bias, x, True)
+        want = _reference_impl(h1, w, bias, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-4)
+
+        from iwae_replication_project_tpu.ops.logsumexp import logmeanexp
+
+        def bound_f(ww):
+            return jnp.mean(logmeanexp(fused_bernoulli_ll(h1, ww, bias, x,
+                                                          True), axis=0))
+
+        def bound_r(ww):
+            return jnp.mean(logmeanexp(_reference_impl(h1, ww, bias, x),
+                                       axis=0))
+
+        np.testing.assert_allclose(float(bound_f(w)), float(bound_r(w)),
+                                   rtol=1e-6)
+        g_f = jax.grad(bound_f)(w)
+        g_r = jax.grad(bound_r)(w)
+        np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_r),
+                                   rtol=1e-4, atol=1e-5)
+
     def test_gradients_match_reference(self, problem):
         h1, w, bias, x = problem
 

@@ -1,0 +1,449 @@
+"""Blocked hot-loop tests (ops/hot_loop.py): (k, batch)-tiled kernel parity
+in interpret mode, the blocked-scan fallback, trace-time path selection, and
+the kernel_path telemetry — ISSUE 6."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu.models import (
+    ModelConfig,
+    init_params,
+    log_weights,
+)
+from iwae_replication_project_tpu.ops import hot_loop as hl
+from iwae_replication_project_tpu.ops.logsumexp import logmeanexp
+
+
+def _mk(k, b, h1d, hid, d, seed=0):
+    rs = np.random.RandomState(seed)
+    return (jnp.asarray(rs.randn(k, b, h1d).astype(np.float32)),
+            jnp.asarray(rs.randn(h1d, hid).astype(np.float32) * 0.2),
+            jnp.asarray(rs.randn(hid).astype(np.float32) * 0.1),
+            jnp.asarray(rs.randn(hid, hid).astype(np.float32) * 0.2),
+            jnp.asarray(rs.randn(hid).astype(np.float32) * 0.1),
+            jnp.asarray(rs.randn(hid, d).astype(np.float32) * 0.2),
+            jnp.asarray(rs.randn(d).astype(np.float32) * 0.1),
+            jnp.asarray((rs.rand(b, d) > 0.5).astype(np.float32)))
+
+
+def _ref_grads(args, g):
+    def f(*ps):
+        return hl._reference_impl(*ps, args[-1])
+
+    _, vjp = jax.vjp(f, *args[:-1])
+    return vjp(g)
+
+
+#: the satellite shape grid: odd k/batch (1, 3, 7, 17) x non-multiple-of-128
+#: pixel dims, plus batch sizes that force PARTIAL batch tiles (tb=128)
+SHAPES = [(1, 1, 12), (3, 7, 130), (7, 17, 140), (17, 3, 12), (10, 300, 12)]
+
+
+class TestBlockedKernelParity:
+    @pytest.mark.parametrize("k,b,d", SHAPES)
+    def test_forward_and_backward_match_reference(self, k, b, d):
+        args = _mk(k, b, 8, 16, d)
+        tk, tb = min(8, k), (128 if b > 128 else b)
+        want = hl._reference_impl(*args)
+        got = hl._fwd_pallas(*args, tk=tk, tb=tb, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-4)
+        g = jnp.asarray(np.random.RandomState(1).randn(k, b).astype(np.float32))
+        got_g = hl._bwd_pallas(*args, g, tk=tk, tb=tb, interpret=True)
+        want_g = _ref_grads(args, g)
+        for a, w, name in zip(got_g, want_g,
+                              ("dh", "dw1", "db1", "dw2", "db2", "dw3", "db3")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                       rtol=1e-4, atol=1e-4, err_msg=name)
+
+    def test_custom_vjp_entry_grads(self):
+        """Grads through the public custom-VJP entry (pallas fwd + pallas
+        bwd in interpret mode) against autodiff of the reference."""
+        k, b, d = 5, 6, 12
+        args = _mk(k, b, 8, 16, d)
+        x = args[-1]
+
+        def loss_f(*ps):
+            return jnp.sum(hl._fused_block_ll(*ps, x, min(8, k), b, True,
+                                              None) ** 2)
+
+        def loss_r(*ps):
+            return jnp.sum(hl._reference_impl(*ps, x) ** 2)
+
+        g_f = jax.grad(loss_f, argnums=tuple(range(7)))(*args[:-1])
+        g_r = jax.grad(loss_r, argnums=tuple(range(7)))(*args[:-1])
+        for a, w in zip(g_f, g_r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_bwd_tile_fallback_to_xla(self, monkeypatch):
+        """When no backward tile fits the budget the custom VJP swaps in the
+        XLA backward while keeping the fused forward — grads must still
+        match the reference."""
+        k, b, d = 5, 6, 12
+        args = _mk(k, b, 8, 16, d)
+        x = args[-1]
+        real = hl.kernel_usable_block
+        monkeypatch.setattr(
+            hl, "kernel_usable_block",
+            lambda *a, **kw: None if kw.get("grad") else real(*a, **kw))
+
+        def loss_f(*ps):
+            return jnp.sum(hl._fused_block_ll(*ps, x, min(8, k), b, True,
+                                              None) ** 2)
+
+        def loss_r(*ps):
+            return jnp.sum(hl._reference_impl(*ps, x) ** 2)
+
+        g_f = jax.grad(loss_f, argnums=tuple(range(7)))(*args[:-1])
+        g_r = jax.grad(loss_r, argnums=tuple(range(7)))(*args[:-1])
+        for a, w in zip(g_f, g_r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                       rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("k,b,d", SHAPES)
+    def test_padding_never_leaks_into_logsumexp(self, k, b, d):
+        """Satellite: the zero-padded (k, batch, pixel) tiles must be
+        invisible to downstream ops.logsumexp reductions — logmeanexp over
+        the fused output equals logmeanexp over the reference for every
+        odd shape, fwd AND bwd."""
+        args = _mk(k, b, 8, 16, d)
+        tk, tb = min(8, k), (128 if b > 128 else b)
+
+        def bound_f(*ps):
+            ll = hl._fused_block_ll(*ps, args[-1], tk, tb, True, None)
+            return jnp.mean(logmeanexp(ll, axis=0))
+
+        def bound_r(*ps):
+            return jnp.mean(logmeanexp(hl._reference_impl(*ps, args[-1]),
+                                       axis=0))
+
+        got, want = bound_f(*args[:-1]), bound_r(*args[:-1])
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+        g_f = jax.grad(bound_f, argnums=(1,))(*args[:-1])[0]
+        g_r = jax.grad(bound_r, argnums=(1,))(*args[:-1])[0]
+        np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_r),
+                                   rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("block_k", [1, 2, 3, 8])
+    def test_blocked_scan_bitwise_vs_reference(self, block_k):
+        """The hand-blocked scan re-runs the identical per-slab math: its
+        forward must be BITWISE equal to the one-shot composition."""
+        args = _mk(7, 6, 8, 16, 130)
+        want = hl._reference_impl(*args)
+        got = hl._blocked_scan_impl(*args, block_k=block_k)
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+    def test_blocked_scan_grads_match(self):
+        args = _mk(7, 6, 8, 16, 12)
+        x = args[-1]
+
+        def loss_s(*ps):
+            return jnp.sum(hl._blocked_scan_impl(*ps, x, block_k=2) ** 2)
+
+        def loss_r(*ps):
+            return jnp.sum(hl._reference_impl(*ps, x) ** 2)
+
+        g_s = jax.grad(loss_s, argnums=tuple(range(7)))(*args[:-1])
+        g_r = jax.grad(loss_r, argnums=tuple(range(7)))(*args[:-1])
+        for a, w in zip(g_s, g_r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_bf16_compute_dtype_parity(self):
+        """bf16 operand casts inside the kernel mirror mlp.dense_apply's
+        bf16 matmuls: fused output tracks the bf16 reference composition."""
+        args = _mk(5, 6, 8, 16, 12)
+        want = hl._reference_impl(*args, compute_dtype="bfloat16")
+        got = hl._fwd_pallas(*args, tk=5, tb=6, interpret=True,
+                             compute_dtype="bfloat16")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-2, atol=2e-2)
+
+
+class TestSelection:
+    def test_block_estimates_flagship_and_eval_shapes(self):
+        # flagship train (k=50, B=100, H1=100, hid=200, 784 px): the fwd
+        # tile is the full batch; the larger bwd working set does not fit
+        # at any legal tile -> backward falls back to XLA
+        assert hl.select_block(50, 100, 100, 200, 784) == (8, 100)
+        assert hl.select_block(50, 100, 100, 200, 784, grad=True) is None
+        # the batch-500 eval shape the k-only predecessor had to reject
+        # entirely now runs fused through a PARTIAL batch tile
+        assert hl.select_block(250, 500, 100, 200, 784) == (8, 128)
+
+    def test_env_forced_paths_bitwise_identical(self, monkeypatch, rng):
+        cfg_f = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                            n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12,
+                            likelihood="logits", fused_likelihood=True)
+        cfg_p = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                            n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12,
+                            likelihood="logits")
+        params = init_params(rng, cfg_p)
+        x = (jax.random.uniform(jax.random.PRNGKey(1), (6, 12)) > 0.5
+             ).astype(jnp.float32)
+        key = jax.random.PRNGKey(2)
+        want = log_weights(params, cfg_p, key, x, k=4)
+        for path in ("reference", "blocked_scan", "pallas"):
+            monkeypatch.setenv("IWAE_HOT_LOOP_PATH", path)
+            got = log_weights(params, cfg_f, key, x, k=4)
+            assert np.array_equal(np.asarray(got), np.asarray(want)), path
+
+    def test_auto_on_cpu_selects_reference(self, monkeypatch):
+        monkeypatch.delenv("IWAE_HOT_LOOP_PATH", raising=False)
+        assert hl.select_path(4, 6, 4, 16, 12, on_tpu=False)[0] == "reference"
+
+    def test_auto_scan_threshold(self, monkeypatch):
+        monkeypatch.setenv("IWAE_HOT_LOOP_SCAN_BYTES", "1")
+        path, _ = hl.select_path(4, 6, 4, 16, 12, on_tpu=False)
+        assert path == "blocked_scan"
+
+    def test_invalid_path_env_raises(self, monkeypatch):
+        monkeypatch.setenv("IWAE_HOT_LOOP_PATH", "mosaic")
+        with pytest.raises(ValueError, match="IWAE_HOT_LOOP_PATH"):
+            hl.select_path(4, 6, 4, 16, 12, on_tpu=False)
+
+    def test_forced_pallas_without_tile_falls_back(self, monkeypatch):
+        monkeypatch.setenv("IWAE_HOT_LOOP_PATH", "pallas")
+        monkeypatch.setenv("IWAE_FUSED_VMEM_BUDGET", "1")
+        with pytest.warns(RuntimeWarning, match="no tile fits"):
+            path, _ = hl.select_path(4, 6, 4, 16, 12, on_tpu=False)
+        assert path == "blocked_scan"
+
+    def test_probe_compile_failure_selects_fallback(self, monkeypatch):
+        """A shape that passes the estimate but fails to compile must warn
+        once, cache the verdict, and select the fallback — never crash the
+        enclosing jit (the kernel_usable contract)."""
+        calls = []
+
+        def boom(*a, **kw):
+            calls.append(a)
+            raise RuntimeError("scoped vmem exceeded (simulated)")
+
+        monkeypatch.setattr(hl, "_probe_cache", {})
+        monkeypatch.setattr(hl, "_fwd_pallas", boom)
+        monkeypatch.setattr(hl, "_bwd_pallas", boom)
+        with pytest.warns(RuntimeWarning, match="failed to compile"):
+            assert hl.kernel_usable_block(8, 4, 8, 16, 12,
+                                          interpret=False) is None
+        assert len(calls) == 1
+        # cached: the second query neither warns nor re-probes
+        assert hl.kernel_usable_block(8, 4, 8, 16, 12,
+                                      interpret=False) is None
+        assert len(calls) == 1
+
+    def test_probe_cache_invalidated_by_budget_change(self, monkeypatch):
+        calls = []
+
+        def fake_probe(*a, **kw):
+            calls.append(a)
+            return True
+
+        monkeypatch.setattr(hl, "_probe_cache", {})
+        monkeypatch.setattr(hl, "_probe_compiles", fake_probe)
+        monkeypatch.setenv("IWAE_FUSED_VMEM_BUDGET", str(1 << 30))
+        assert hl.kernel_usable_block(8, 4, 8, 16, 12,
+                                      interpret=False) is not None
+        assert len(calls) == 1
+        assert hl.kernel_usable_block(8, 4, 8, 16, 12,
+                                      interpret=False) is not None
+        assert len(calls) == 1
+        monkeypatch.setenv("IWAE_FUSED_VMEM_BUDGET", str((1 << 30) + 1))
+        assert hl.kernel_usable_block(8, 4, 8, 16, 12,
+                                      interpret=False) is not None
+        assert len(calls) == 2
+
+
+class TestFallbackTraining:
+    """Satellite: force the VMEM gate shut and pin the blocked-scan path's
+    losses + recompile behavior."""
+
+    def _cfgs(self):
+        cfg_f = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                            n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12,
+                            likelihood="logits", fused_likelihood=True)
+        cfg_p = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                            n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12,
+                            likelihood="logits")
+        return cfg_f, cfg_p
+
+    def test_blocked_scan_losses_bit_identical(self, monkeypatch, rng):
+        """fits_vmem forced to fail (budget=1) with pallas asked for ->
+        blocked scan; the per-batch IWAE losses must be BIT-identical to
+        the unfused reference model (same RNG, same per-row math)."""
+        from iwae_replication_project_tpu.objectives import (
+            ObjectiveSpec, objective_value_and_grad)
+
+        monkeypatch.setenv("IWAE_HOT_LOOP_PATH", "pallas")
+        monkeypatch.setenv("IWAE_FUSED_VMEM_BUDGET", "1")
+        cfg_f, cfg_p = self._cfgs()
+        params = init_params(rng, cfg_p)
+        spec = ObjectiveSpec("IWAE", k=4)
+        for i in range(3):
+            key = jax.random.fold_in(jax.random.PRNGKey(7), i)
+            x = (jax.random.uniform(key, (6, 12)) > 0.5).astype(jnp.float32)
+            with pytest.warns(RuntimeWarning, match="no tile fits"):
+                bound_f, grads_f = objective_value_and_grad(
+                    spec, params, cfg_f, key, x)
+            bound_p, grads_p = objective_value_and_grad(
+                spec, params, cfg_p, key, x)
+            assert float(bound_f) == float(bound_p)  # bit-identical losses
+            for a, w in zip(jax.tree.leaves(grads_f),
+                            jax.tree.leaves(grads_p)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(w),
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_fallback_causes_zero_extra_recompiles(self, monkeypatch, rng):
+        """Path selection is trace-time static: re-dispatching the compiled
+        program under the forced fallback never re-enters XLA."""
+        from iwae_replication_project_tpu.utils.compile_cache import (
+            aot_call, cache_stats, isolated_aot_registry, stats_delta)
+
+        monkeypatch.setenv("IWAE_HOT_LOOP_PATH", "blocked_scan")
+        cfg_f, _ = self._cfgs()
+        params = init_params(rng, cfg_f)
+        x = (jax.random.uniform(jax.random.PRNGKey(1), (6, 12)) > 0.5
+             ).astype(jnp.float32)
+        key = jax.random.PRNGKey(2)
+
+        @jax.jit
+        def loss(p, key, x):
+            return -jnp.mean(log_weights(p, cfg_f, key, x, 4))
+
+        with isolated_aot_registry():
+            s0 = cache_stats()
+            first = aot_call("hot_loop_fallback_loss", loss, (params, key, x))
+            d1 = stats_delta(s0)
+            assert d1["aot_misses"] == 1
+            s1 = cache_stats()
+            second = aot_call("hot_loop_fallback_loss", loss,
+                              (params, key, x))
+            d2 = stats_delta(s1)
+            assert d2["aot_misses"] == 0            # warm hit
+            assert d2["persistent_cache_misses"] == 0  # zero recompiles
+        assert float(first) == float(second)
+
+
+class TestTelemetry:
+    def test_selection_records_gauge_and_counters(self, monkeypatch):
+        from iwae_replication_project_tpu.telemetry.registry import (
+            get_registry)
+
+        before = hl.path_counters().get("blocked_scan", 0)
+        monkeypatch.setenv("IWAE_HOT_LOOP_PATH", "blocked_scan")
+        args = _mk(4, 6, 8, 16, 12)
+        out = {"l1": {"w": args[1], "b": args[2]},
+               "l2": {"w": args[3], "b": args[4]},
+               "out": {"w": args[5], "b": args[6]}}
+        hl.decoder_score(out, args[-1], args[0], on_tpu=False)
+        assert hl.path_counters()["blocked_scan"] == before + 1
+        assert hl.selected_path_code() == float(
+            hl.PATH_CODES["blocked_scan"])
+        # the pallas selection times its probe under a span/kernel/ name
+        monkeypatch.setenv("IWAE_HOT_LOOP_PATH", "pallas")
+        hl.decoder_score(out, args[-1], args[0], on_tpu=False)
+        snap = get_registry().snapshot()
+        assert "span/kernel/select/pallas" in snap["histograms"]
+
+    def test_serving_metrics_expose_kernel_path(self):
+        from iwae_replication_project_tpu.serving.metrics import (
+            ServingMetrics)
+
+        m = ServingMetrics()
+        assert m.snapshot()["kernel_path"] == 0
+        assert m.flat()["kernel_path"] == 0.0
+
+
+class TestModelIntegration:
+    def test_eval_row_stamps_kernel_path(self, rng):
+        from iwae_replication_project_tpu.evaluation.metrics import (
+            training_statistics)
+
+        cfg = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                          n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12,
+                          likelihood="logits", fused_likelihood=True)
+        params = init_params(rng, cfg)
+        x = (jax.random.uniform(jax.random.PRNGKey(1), (8, 12)) > 0.5
+             ).astype(jnp.float32)
+        acc, _ = training_statistics(params, cfg, jax.random.PRNGKey(2), x,
+                                     k=4, batch_size=4, nll_k=8, nll_chunk=4,
+                                     activity_samples=8,
+                                     include_pruned_nll=False)
+        assert acc["kernel_path"] in {float(v) for v in hl.PATH_CODES.values()}
+
+    def test_eval_stamp_immune_to_unrelated_selections(self, monkeypatch,
+                                                       rng):
+        """The row stamp must describe the row's OWN config, not whichever
+        program traced last (a jit-cache-hit dispatch traces nothing, so a
+        last-trace gauge would misattribute it)."""
+        from iwae_replication_project_tpu.evaluation.metrics import (
+            training_statistics)
+
+        # poison the last-trace gauge with a blocked_scan selection from an
+        # unrelated shape
+        monkeypatch.setenv("IWAE_HOT_LOOP_PATH", "blocked_scan")
+        args = _mk(4, 6, 8, 16, 12)
+        out = {"l1": {"w": args[1], "b": args[2]},
+               "l2": {"w": args[3], "b": args[4]},
+               "out": {"w": args[5], "b": args[6]}}
+        hl.decoder_score(out, args[-1], args[0], on_tpu=False)
+        assert hl.selected_path_code() == float(
+            hl.PATH_CODES["blocked_scan"])
+        monkeypatch.delenv("IWAE_HOT_LOOP_PATH")
+
+        # an UNFUSED config's eval row must still stamp reference
+        cfg = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                          n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12,
+                          likelihood="logits")
+        params = init_params(rng, cfg)
+        x = (jax.random.uniform(jax.random.PRNGKey(1), (8, 12)) > 0.5
+             ).astype(jnp.float32)
+        acc, _ = training_statistics(params, cfg, jax.random.PRNGKey(2), x,
+                                     k=4, batch_size=4, nll_k=8, nll_chunk=4,
+                                     activity_samples=8,
+                                     include_pruned_nll=False)
+        assert acc["kernel_path"] == float(hl.PATH_CODES["reference"])
+
+    def test_path_code_for_model_matches_dispatch(self, monkeypatch):
+        cfg = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                          n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12,
+                          likelihood="logits", fused_likelihood=True)
+        monkeypatch.setenv("IWAE_HOT_LOOP_PATH", "blocked_scan")
+        assert hl.path_code_for_model(cfg, 4, 6, on_tpu=False) == float(
+            hl.PATH_CODES["blocked_scan"])
+        monkeypatch.delenv("IWAE_HOT_LOOP_PATH")
+        assert hl.path_code_for_model(cfg, 4, 6, on_tpu=False) == float(
+            hl.PATH_CODES["reference"])
+        # unfused config -> reference regardless of environment
+        monkeypatch.setenv("IWAE_HOT_LOOP_PATH", "pallas")
+        cfg_u = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                            n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12,
+                            likelihood="logits")
+        assert hl.path_code_for_model(cfg_u, 4, 6, on_tpu=False) == float(
+            hl.PATH_CODES["reference"])
+
+    def test_flops_accounting_matches_flagship_table(self):
+        """utils/flops derives the r05 hard-coded flagship numbers exactly."""
+        from iwae_replication_project_tpu.utils import flops
+
+        cfg = ModelConfig.two_layer(likelihood="logits")
+        no_k, per_k = flops.per_row_macs(cfg)
+        assert no_k == 784 * 200 + 200 * 200 + 2 * 200 * 100
+        assert per_k == ((100 * 100 + 100 * 100 + 2 * 100 * 50)
+                         + (50 * 100 + 100 * 100 + 2 * 100 * 100)
+                         + (100 * 200 + 200 * 200 + 200 * 784))
+        assert flops.train_step_flops(cfg, 100, 50) == 3.0 * 2.0 * (
+            100 * no_k + 100 * 50 * per_k)
+
+    def test_peak_flops_table_detection(self):
+        from iwae_replication_project_tpu.utils.flops import (
+            peak_flops_for_kind)
+
+        assert peak_flops_for_kind("TPU v5 lite")[0] == 197e12
+        assert peak_flops_for_kind("TPU v5p")[0] == 459e12
+        assert peak_flops_for_kind("TPU v4")[0] == 275e12
+        assert peak_flops_for_kind("TPU v6e")[0] == 918e12
+        peak, source = peak_flops_for_kind("warp drive 9000")
+        assert peak is None and "warp drive 9000" in source
