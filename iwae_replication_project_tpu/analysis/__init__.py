@@ -10,6 +10,7 @@ NaN checking around marked tests) live in tests/conftest.py ``--sanitize``.
 from iwae_replication_project_tpu.analysis.config import LintConfig, load_config
 from iwae_replication_project_tpu.analysis.core import (
     BARE_SUPPRESSION,
+    USELESS_SUPPRESSION,
     Finding,
     Rule,
     all_rules,
@@ -20,6 +21,7 @@ from iwae_replication_project_tpu.analysis.core import (
 
 __all__ = [
     "BARE_SUPPRESSION",
+    "USELESS_SUPPRESSION",
     "Finding",
     "LintConfig",
     "Rule",

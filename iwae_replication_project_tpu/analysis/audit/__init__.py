@@ -1,0 +1,39 @@
+"""iwae-audit: jaxpr-level program auditor (the lint suite's deeper twin).
+
+Where analysis/rules/ checks the SOURCE, this package checks the TRACED
+PROGRAMS — the jaxprs XLA compiles — for the hazard classes that live below
+the AST: donation vs the persistent-cache executables (RESULTS.md §5),
+padded rows reaching the IWAE logsumexp unmasked, host callbacks inside hot
+programs, and cache-fragmenting call signatures. See core.py for the
+framework, passes.py for the four built-in passes, taint.py for the padding
+dataflow engine, and programs.py for the audited production-program suite.
+"""
+
+from iwae_replication_project_tpu.analysis.audit.core import (
+    BARE_WAIVER,
+    AuditEnv,
+    AuditFinding,
+    AuditPass,
+    AuditProgram,
+    all_passes,
+    register,
+    run_audit,
+    select_passes,
+)
+from iwae_replication_project_tpu.analysis.audit.jaxprs import (
+    iter_eqns,
+    primitive_histogram,
+    signature,
+)
+from iwae_replication_project_tpu.analysis.audit.programs import (
+    PROGRAM_NAMES,
+    build_programs,
+)
+from iwae_replication_project_tpu.analysis.audit.taint import TaintEngine
+
+__all__ = [
+    "BARE_WAIVER", "AuditEnv", "AuditFinding", "AuditPass", "AuditProgram",
+    "all_passes", "register", "run_audit", "select_passes",
+    "iter_eqns", "primitive_histogram", "signature",
+    "PROGRAM_NAMES", "build_programs", "TaintEngine",
+]

@@ -1,0 +1,5 @@
+import sys
+
+from iwae_replication_project_tpu.analysis.audit.cli import main
+
+sys.exit(main())

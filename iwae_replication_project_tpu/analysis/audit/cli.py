@@ -1,0 +1,110 @@
+"""Audit CLI: ``python -m iwae_replication_project_tpu.analysis.audit`` /
+the ``iwae-audit`` console script.
+
+Exit codes match the lint CLI's contract and are load-bearing for
+scripts/check.py: **0** = every pass clean on every program, **1** =
+findings, **2** = internal/usage error (the analyzer itself failed — check.py
+reports this as a crash, never as findings). ``--format json`` emits one
+machine-readable object (findings + per-pass counts + the audited program
+list); the default human format is one finding per line plus a per-pass
+tally and the per-program trace table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import traceback
+from collections import Counter
+from typing import List, Optional
+
+from iwae_replication_project_tpu.analysis.audit import core
+from iwae_replication_project_tpu.analysis.audit.jaxprs import signature
+from iwae_replication_project_tpu.analysis.audit.programs import (
+    PROGRAM_NAMES,
+    build_programs,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="iwae-audit",
+        description="Jaxpr-level program auditor: donation safety, padding "
+                    "taint, in-graph host transfers, and recompile "
+                    "cardinality over the repo's real traced programs.")
+    p.add_argument("--format", choices=("human", "json"), default="human")
+    p.add_argument("--list-passes", action="store_true",
+                   help="print the registered passes and exit")
+    p.add_argument("--select", default=None,
+                   help="comma-separated pass names to run (only these)")
+    p.add_argument("--programs", default=None,
+                   help=f"comma-separated subset of the audited programs "
+                        f"(default: all of {', '.join(PROGRAM_NAMES)})")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.list_passes:
+            passes = core.all_passes()
+            width = max(len(n) for n in passes)
+            for name in sorted(passes):
+                print(f"{name:<{width}}  {passes[name].summary}")
+            return 0
+
+        # tracing may trigger tiny init compiles (model params); route them
+        # through the shared persistent cache like every other entry point
+        from iwae_replication_project_tpu.utils.compile_cache import (
+            setup_persistent_cache)
+        setup_persistent_cache(None)
+
+        passes = core.select_passes(
+            [s.strip() for s in args.select.split(",") if s.strip()]
+            if args.select else None)
+        include = [s.strip() for s in args.programs.split(",") if s.strip()] \
+            if args.programs else None
+        programs = build_programs(include)
+        env = core.AuditEnv.current(include_registry=True)
+        findings = core.run_audit(programs, passes, env)
+    except (ValueError, FileNotFoundError) as e:
+        print(f"iwae-audit: error: {e}", file=sys.stderr)
+        return 2
+    except Exception:
+        print("iwae-audit: internal error:", file=sys.stderr)
+        traceback.print_exc()
+        return 2
+
+    counts = dict(Counter(f.rule for f in findings))
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "counts": counts,
+            "total": len(findings),
+            "passes": sorted(passes),
+            "programs": {p.name: signature(p.jaxpr) for p in programs},
+            "env": {"backend": env.backend, "cache_dir": env.cache_dir},
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.human())
+        print(f"audited {len(programs)} program(s) with "
+              f"{len(passes)} pass(es) on backend={env.backend}")
+        for p in programs:
+            sig = signature(p.jaxpr)
+            print(f"  {p.name:<24} {sig['eqn_count']:>5} eqns, "
+                  f"{len(sig['primitives'])} distinct primitives"
+                  + (f", {len(p.taints)} tainted input(s)" if p.taints
+                     else ""))
+        if findings:
+            tally = ", ".join(f"{rule}: {n}"
+                              for rule, n in sorted(counts.items()))
+            print(f"\n{len(findings)} finding(s) ({tally})")
+        else:
+            print("iwae-audit: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

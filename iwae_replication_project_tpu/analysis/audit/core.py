@@ -1,0 +1,193 @@
+"""Audit-framework core: findings, the pass registry, waivers, the runner.
+
+The AST lint suite (analysis/rules/) guards the *source*; this framework
+guards the *traced programs* — the jaxprs XLA actually compiles. The hazards
+it exists for are the ones that bit this repo below the AST: the
+donation-vs-persistent-cache executable corruption (RESULTS.md §5), padded
+rows reaching the IWAE ``logsumexp`` unmasked (a silently biased bound,
+Burda et al. arXiv:1509.00519), host callbacks inside hot programs, and
+signature shapes that fragment the jit/AOT caches under serving traffic.
+The diagnostics rationale follows Rainforth et al. (arXiv:1802.04537):
+verify the estimator *machinery*, not only its outputs.
+
+Mirrors analysis/core.py deliberately:
+
+* a **pass** subclasses :class:`AuditPass`, registers via :func:`register`,
+  and yields :class:`AuditFinding`s for one :class:`AuditProgram`;
+* **waivers** are the audit's suppressions: a program registration may carry
+  ``waivers={"pass-name": "why this is safe"}``. A waiver with an empty
+  justification is itself a finding (``bare-waiver``) — same policy as the
+  lint suite's mandatory ``-- why`` tails;
+* the **runner** (:func:`run_audit`) times every pass under a
+  ``span/audit/<pass>`` span and lands per-pass finding counts on the
+  process metric registry (``audit/<pass>/findings``), so CI gate runs are
+  observable like everything else.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Type
+
+#: finding id for a waiver with no justification text (not waivable itself)
+BARE_WAIVER = "bare-waiver"
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class AuditFinding:
+    """One pass violation in one traced program (`location` is an equation
+    path like ``pjit[0]/scan[2]/reduce_sum[4]``, or a named non-jaxpr site
+    such as ``signature`` / ``registry:<program>``)."""
+
+    program: str
+    rule: str
+    location: str
+    message: str
+
+    def human(self) -> str:
+        return f"{self.program}: [{self.rule}] {self.location}: {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class AuditProgram:
+    """One traced program under audit.
+
+    `jaxpr` is the ``jax.make_jaxpr`` output; `taints` maps flat input index
+    -> ``{axis: real_extent}`` (rows >= extent are padding); `sig_args` is
+    the representative ``(args, kwargs)`` the caller would dispatch with —
+    the recompile-cardinality pass audits the AOT-registry key they produce;
+    `hot` marks per-step/per-dispatch programs (host-transfer pass scope);
+    `waivers` maps pass name -> justification.
+    """
+
+    name: str
+    jaxpr: object
+    taints: Dict[int, Dict[int, Optional[int]]] = \
+        dataclasses.field(default_factory=dict)
+    sig_args: Optional[tuple] = None
+    hot: bool = True
+    waivers: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class AuditEnv:
+    """Execution environment facts the passes condition on (injectable so
+    fixtures can audit counterfactual platforms)."""
+
+    backend: str
+    cache_dir: Optional[str]
+    #: (name, build_key, signature) rows of the live AOT registry, or None
+    #: to skip registry auditing (fixture runs — the process registry holds
+    #: unrelated programs from other tests)
+    registry: Optional[list] = None
+
+    @staticmethod
+    def current(include_registry: bool = False) -> "AuditEnv":
+        import jax
+
+        from iwae_replication_project_tpu.utils.compile_cache import (
+            registry_signatures)
+        return AuditEnv(
+            backend=jax.default_backend(),
+            cache_dir=getattr(jax.config, "jax_compilation_cache_dir", None),
+            registry=registry_signatures() if include_registry else None)
+
+
+class AuditPass:
+    """Base class. Subclasses set ``name``/``summary`` and implement
+    :meth:`check`, yielding findings for one program. Cross-program state
+    (the live AOT registry) is audited in :meth:`check_env` instead — run
+    ONCE per audit, not once per program, and deliberately outside the
+    per-program waiver scope (one program's waiver must not silence a
+    registry-wide hazard)."""
+
+    name: str = ""
+    summary: str = ""
+
+    def check(self, prog: AuditProgram, env: AuditEnv
+              ) -> Iterator[AuditFinding]:
+        raise NotImplementedError
+
+    def check_env(self, env: AuditEnv) -> Iterator[AuditFinding]:
+        return iter(())
+
+
+_REGISTRY: Dict[str, AuditPass] = {}
+
+
+def register(cls: Type[AuditPass]) -> Type[AuditPass]:
+    if not cls.name:
+        raise ValueError(f"pass {cls.__name__} has no name")
+    if cls.name in _REGISTRY:
+        raise ValueError(f"duplicate pass name {cls.name!r}")
+    _REGISTRY[cls.name] = cls()
+    return cls
+
+
+def all_passes() -> Dict[str, AuditPass]:
+    """Name -> pass instance (importing ``passes`` registers the built-ins)."""
+    import iwae_replication_project_tpu.analysis.audit.passes  # noqa: F401
+    return dict(_REGISTRY)
+
+
+def select_passes(select: Optional[Sequence[str]] = None
+                  ) -> Dict[str, AuditPass]:
+    passes = all_passes()
+    if select:
+        unknown = set(select) - set(passes)
+        if unknown:
+            raise ValueError(f"unknown pass(es): {sorted(unknown)}; "
+                             f"known: {sorted(passes)}")
+        passes = {n: p for n, p in passes.items() if n in select}
+    return passes
+
+
+def run_audit(programs: Sequence[AuditProgram],
+              passes: Optional[Dict[str, AuditPass]] = None,
+              env: Optional[AuditEnv] = None,
+              progress: Optional[Callable[[str], None]] = None
+              ) -> List[AuditFinding]:
+    """Run every pass over every program; returns sorted findings.
+
+    Waived findings are dropped (and counted as ``audit/<pass>/waived``);
+    a waiver with no justification adds a ``bare-waiver`` finding instead.
+    """
+    from iwae_replication_project_tpu.telemetry.registry import get_registry
+    from iwae_replication_project_tpu.telemetry.spans import span
+
+    passes = passes if passes is not None else all_passes()
+    env = env or AuditEnv.current()
+    reg = get_registry()
+    findings: List[AuditFinding] = []
+
+    for prog in programs:
+        for pname, justification in prog.waivers.items():
+            if pname in passes and not (justification or "").strip():
+                findings.append(AuditFinding(
+                    program=prog.name, rule=BARE_WAIVER, location="waivers",
+                    message=f"waiver for pass '{pname}' has no justification"
+                            f" — every silenced hazard must carry its "
+                            f"argument"))
+
+    for pname, p in passes.items():
+        if progress:
+            progress(pname)
+        with span(f"audit/{pname}"):
+            for prog in programs:
+                got = list(p.check(prog, env))
+                if pname in prog.waivers and \
+                        (prog.waivers[pname] or "").strip():
+                    reg.counter(f"audit/{pname}/waived").inc(len(got))
+                    continue
+                findings.extend(got)
+                reg.counter(f"audit/{pname}/findings").inc(len(got))
+            # cross-program state: once per pass, unwaivable per-program
+            env_got = list(p.check_env(env))
+            findings.extend(env_got)
+            reg.counter(f"audit/{pname}/findings").inc(len(env_got))
+        reg.counter(f"audit/{pname}/runs").inc()
+
+    return sorted(set(findings))

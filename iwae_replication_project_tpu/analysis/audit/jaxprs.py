@@ -1,0 +1,90 @@
+"""Jaxpr plumbing shared by every audit pass: version-portable access to the
+core types, recursive equation iteration (descending into the sub-jaxprs that
+``pjit``/``scan``/``cond``/``custom_vjp``/``pallas_call`` carry in their
+params), and the structural program signature the golden snapshot tests pin.
+
+The audit deliberately works on *traced* programs (``jax.make_jaxpr``
+output): that is the representation XLA actually compiles, so dataflow facts
+proven here hold for the executable — unlike the AST rules next door, which
+see only the source text that *produced* the trace.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import Counter
+from typing import Any, Iterator, List, Tuple
+
+
+@functools.lru_cache(maxsize=1)
+def core_types() -> Tuple[type, type, type, type]:
+    """``(Jaxpr, ClosedJaxpr, Var, Literal)`` for the running jax version."""
+    import jax
+
+    c = jax.core
+    return c.Jaxpr, c.ClosedJaxpr, c.Var, c.Literal
+
+
+def open_jaxpr(j: Any) -> Any:
+    """The plain ``Jaxpr`` under a ``ClosedJaxpr`` (identity otherwise)."""
+    return j.jaxpr if hasattr(j, "jaxpr") else j
+
+
+def sub_jaxprs(eqn: Any) -> Iterator[Tuple[str, Any]]:
+    """``(param_key, Jaxpr | ClosedJaxpr)`` for every sub-program an equation
+    carries — ``pjit``/``remat2`` (``jaxpr``), ``scan``/``while`` bodies,
+    ``cond`` ``branches``, ``custom_vjp_call_jaxpr`` (``fun_jaxpr``),
+    ``pallas_call`` kernels. Non-jaxpr params (thunks, shardings) are skipped.
+    """
+    Jaxpr, ClosedJaxpr, _, _ = core_types()
+    for key in sorted(eqn.params):
+        val = eqn.params[key]
+        items = val if isinstance(val, (tuple, list)) else (val,)
+        for item in items:
+            if isinstance(item, (Jaxpr, ClosedJaxpr)):
+                yield key, item
+
+
+def iter_eqns(jaxpr: Any, path: str = "") -> Iterator[Tuple[str, Any]]:
+    """Every equation in the program, depth-first, with a human-readable
+    location path like ``pjit[0]/scan[1]/reduce_sum[4]``."""
+    for i, eqn in enumerate(open_jaxpr(jaxpr).eqns):
+        here = f"{path}/{eqn.primitive.name}[{i}]" if path \
+            else f"{eqn.primitive.name}[{i}]"
+        yield here, eqn
+        for _, sub in sub_jaxprs(eqn):
+            yield from iter_eqns(sub, here)
+
+
+def used_vars(jaxpr: Any) -> set:
+    """Vars of THIS jaxpr that are consumed: referenced by some equation or
+    returned as an output. (Sub-jaxprs own their vars; an outer var feeding a
+    sub-call appears in that call equation's invars, so one level suffices.)
+    """
+    _, _, Var, _ = core_types()
+    j = open_jaxpr(jaxpr)
+    used = {v for v in j.outvars if isinstance(v, Var)}
+    for eqn in j.eqns:
+        used.update(v for v in eqn.invars if isinstance(v, Var))
+    return used
+
+
+def primitive_histogram(jaxpr: Any) -> Counter:
+    """Recursive ``{primitive name: count}`` over the whole program."""
+    return Counter(eqn.primitive.name for _, eqn in iter_eqns(jaxpr))
+
+
+def signature(jaxpr: Any) -> dict:
+    """Structural fingerprint for the golden snapshot tests: total equation
+    count plus the primitive histogram. Shape-free on purpose — ``k``/batch
+    scaling changes array extents, not program structure, so the goldens stay
+    stable across problem sizes and only genuine program drift (new
+    primitives, changed composition) trips them."""
+    hist = primitive_histogram(jaxpr)
+    return {"eqn_count": int(sum(hist.values())),
+            "primitives": {name: int(n) for name, n in sorted(hist.items())}}
+
+
+def outer_avals(closed_jaxpr: Any) -> List[Any]:
+    """Abstract values of the program's top-level inputs."""
+    return [v.aval for v in open_jaxpr(closed_jaxpr).invars]

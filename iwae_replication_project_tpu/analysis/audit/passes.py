@@ -1,0 +1,214 @@
+"""The built-in audit passes. Importing this module registers all four.
+
+Each pass is the jaxpr-level twin of a hazard this repo has actually hit:
+
+* ``donation-safety``      — RESULTS.md §5: donated buffers + CPU persistent
+  cache corrupt memory; and a donated-but-unconsumed input invalidates the
+  caller's buffer for nothing.
+* ``padding-taint``        — the IWAE bound is one unmasked padded weight
+  away from silent bias (ops/taint.py carries the dataflow engine).
+* ``host-transfer``        — callbacks/infeed inside per-step programs stall
+  the dispatch pipeline from *inside* the graph, where the AST host-sync
+  rule cannot see them.
+* ``recompile-cardinality`` — weak types, python-scalar signature leaves,
+  and scalar closure leaks each mint gratuitous executables; under serving
+  traffic that is a compile storm (and an unbounded AOT registry).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from iwae_replication_project_tpu.analysis.audit.core import (
+    AuditEnv,
+    AuditFinding,
+    AuditPass,
+    AuditProgram,
+    register,
+)
+from iwae_replication_project_tpu.analysis.audit.jaxprs import (
+    iter_eqns,
+    open_jaxpr,
+    outer_avals,
+    used_vars,
+)
+from iwae_replication_project_tpu.analysis.audit.taint import TaintEngine
+
+#: jaxpr-level primitives that move data or control to the host mid-program
+_HOST_PRIM_NAMES = {"infeed", "outfeed", "debug_print"}
+
+
+def _is_host_prim(name: str) -> bool:
+    return name in _HOST_PRIM_NAMES or "callback" in name
+
+
+@register
+class DonationSafetyPass(AuditPass):
+    name = "donation-safety"
+    summary = ("every donated input is consumed, and donation never rides a "
+               "CPU persistent-cache executable (RESULTS.md §5)")
+
+    def check(self, prog: AuditProgram, env: AuditEnv
+              ) -> Iterator[AuditFinding]:
+        donating_sites: List[str] = []
+        for loc, eqn in iter_eqns(prog.jaxpr):
+            donated = eqn.params.get("donated_invars")
+            if not donated or not any(donated):
+                continue
+            donating_sites.append(loc)
+            sub = eqn.params.get("jaxpr")
+            if sub is None:
+                continue
+            used = used_vars(sub)
+            invars = open_jaxpr(sub).invars
+            for i, d in enumerate(donated):
+                if d and i < len(invars) and invars[i] not in used:
+                    yield AuditFinding(
+                        program=prog.name, rule=self.name, location=loc,
+                        message=f"input #{i} is donated but never consumed "
+                                f"by the program — the caller's buffer is "
+                                f"invalidated for nothing (and any later "
+                                f"read of it is backend-dependent garbage)")
+        if donating_sites and env.backend == "cpu" and env.cache_dir:
+            yield AuditFinding(
+                program=prog.name, rule=self.name,
+                location=donating_sites[0],
+                message="program donates buffers while the persistent "
+                        "compilation cache is active on the CPU backend — "
+                        "cache-deserialized XLA:CPU executables mishandle "
+                        "input-output aliasing (RESULTS.md §5); gate the "
+                        "donation on utils.compile_cache.donation_safe()")
+
+
+@register
+class PaddingTaintPass(AuditPass):
+    name = "padding-taint"
+    summary = ("padded rows (declared inputs + pad equations) provably never "
+               "reach a reduce/logsumexp/contraction unmasked")
+
+    def check(self, prog: AuditProgram, env: AuditEnv
+              ) -> Iterator[AuditFinding]:
+        from iwae_replication_project_tpu.telemetry.registry import (
+            get_registry)
+
+        findings: List[AuditFinding] = []
+        engine = TaintEngine(report=lambda loc, msg: findings.append(
+            AuditFinding(program=prog.name, rule=self.name, location=loc,
+                         message=msg)))
+        engine.run(prog.jaxpr, prog.taints)
+        reg = get_registry()
+        if engine.stats.default_propagation:
+            reg.counter("audit/padding-taint/default-propagation").inc(
+                engine.stats.default_propagation)
+        if engine.stats.opaque_calls:
+            reg.counter("audit/padding-taint/opaque-kernels").inc(
+                engine.stats.opaque_calls)
+        if engine.stats.unverified_mask_discharges:
+            reg.counter("audit/padding-taint/unverified-mask-discharges").inc(
+                engine.stats.unverified_mask_discharges)
+        yield from findings
+
+
+@register
+class HostTransferPass(AuditPass):
+    name = "host-transfer"
+    summary = ("no callbacks/infeed/outfeed inside hot programs — the "
+               "jaxpr-level twin of the AST host-sync rule")
+
+    def check(self, prog: AuditProgram, env: AuditEnv
+              ) -> Iterator[AuditFinding]:
+        if not prog.hot:
+            return
+        for loc, eqn in iter_eqns(prog.jaxpr):
+            name = eqn.primitive.name
+            if _is_host_prim(name):
+                yield AuditFinding(
+                    program=prog.name, rule=self.name, location=loc,
+                    message=f"'{name}' inside a hot program forces a "
+                            f"device<->host round-trip on every dispatch — "
+                            f"move the transfer to the driver layer (or "
+                            f"waive with justification for a debug build)")
+
+
+@register
+class RecompileCardinalityPass(AuditPass):
+    name = "recompile-cardinality"
+    summary = ("no weak types, python-scalar signature leaves, or scalar "
+               "closure leaks that fragment the jit/AOT caches")
+
+    def check(self, prog: AuditProgram, env: AuditEnv
+              ) -> Iterator[AuditFinding]:
+        yield from self._check_avals(prog)
+        yield from self._check_consts(prog)
+        if prog.sig_args is not None:
+            from iwae_replication_project_tpu.utils.compile_cache import (
+                _abstract_signature)
+            yield from self._check_signature(
+                prog.name, "signature", _abstract_signature(prog.sig_args))
+
+    def check_env(self, env: AuditEnv) -> Iterator[AuditFinding]:
+        # the live AOT registry: once per audit, never behind a per-program
+        # waiver (and counted once, not once per audited program)
+        for name, build_key, sig in (env.registry or ()):
+            yield from self._check_signature(
+                f"aot:{name}", "registry", sig)
+
+    def _check_avals(self, prog: AuditProgram) -> Iterator[AuditFinding]:
+        for i, aval in enumerate(outer_avals(prog.jaxpr)):
+            if getattr(aval, "weak_type", False):
+                yield AuditFinding(
+                    program=prog.name, rule=self.name, location=f"invar[{i}]",
+                    message=f"program input #{i} is weak-typed ({aval}) — "
+                            f"weak and committed dtypes trace to distinct "
+                            f"executables; pass a committed array "
+                            f"(jnp.asarray with an explicit dtype)")
+
+    def _check_consts(self, prog: AuditProgram) -> Iterator[AuditFinding]:
+        import jax
+
+        consts = getattr(prog.jaxpr, "consts", None) or ()
+        for i, c in enumerate(consts):
+            try:
+                aval = jax.core.get_aval(c)
+            except Exception:
+                continue
+            if getattr(aval, "weak_type", False) and \
+                    getattr(aval, "shape", None) == ():
+                yield AuditFinding(
+                    program=prog.name, rule=self.name, location=f"const[{i}]",
+                    message=f"python scalar captured by closure as a traced "
+                            f"constant (value {c!r}) — every distinct value "
+                            f"rebuilds/re-traces the program; thread it as "
+                            f"an argument or commit it to an array")
+
+    def _check_signature(self, program: str, loc: str, sig
+                         ) -> Iterator[AuditFinding]:
+        # leaf grammar is compile_cache._abstract_signature's: arrays are
+        # (shape tuple, dtype str, sharding str, weak bool); python scalars
+        # are (type name, repr)
+        _, leaves = sig
+        for i, leaf in enumerate(leaves):
+            if len(leaf) == 2:
+                tname, rep = leaf
+                if tname not in ("int", "float", "bool", "complex"):
+                    # kwarg NAMES flatten into the signature pytree as str
+                    # leaves — fixed structure per program, not per-value
+                    # fragmentation; only numeric/bool scalars mint an
+                    # executable per value
+                    continue
+                yield AuditFinding(
+                    program=program, rule=self.name,
+                    location=f"{loc}:leaf[{i}]",
+                    message=f"python {tname} scalar ({rep}) in the dispatch "
+                            f"signature — the AOT registry compiles one "
+                            f"executable PER VALUE; make it a device array "
+                            f"or a deliberate static in the build key")
+            elif len(leaf) >= 4 and leaf[3]:
+                shape, dtype = leaf[0], leaf[1]
+                yield AuditFinding(
+                    program=program, rule=self.name,
+                    location=f"{loc}:leaf[{i}]",
+                    message=f"weak-typed {dtype}{list(shape)} leaf in the "
+                            f"dispatch signature — weak/committed variants "
+                            f"register separate executables and double the "
+                            f"warm path's cache footprint")

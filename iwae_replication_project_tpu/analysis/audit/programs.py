@@ -1,0 +1,211 @@
+"""The audited program suite: the repo's REAL programs, traced.
+
+Builders return :class:`~.core.AuditProgram`s for exactly the programs the
+production stack dispatches — the jitted train step (training/train_step.py),
+the chunked k=5000 eval scorer (evaluation/metrics.streaming_log_px), the
+three serving programs (serving/programs.py, with their declared padded-row
+taints), and all three ops/hot_loop.py paths composed with the
+``iwae_per_example`` reduction they feed. Tracing is ``jax.make_jaxpr`` only:
+no compile, no execution, so the full suite builds in seconds on any host.
+
+Shapes are audit-representative, not production-sized: taint/donation/
+transfer findings are properties of program *structure*, which k and batch
+scale without changing (the same fact that keeps the golden jaxpr signatures
+shape-free). The hot-loop shapes are chosen with pairwise-distinct padded
+axis sizes so the opaque-kernel size-matching rule (taint.py) cannot
+conflate axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from iwae_replication_project_tpu.analysis.audit.core import AuditProgram
+
+#: program names in build order (the CLI's default suite)
+PROGRAM_NAMES = (
+    "train_step",
+    "eval_scorer_k5000",
+    "serve_score",
+    "serve_encode",
+    "serve_decode",
+    "hot_loop_reference",
+    "hot_loop_blocked_scan",
+    "hot_loop_pallas",
+)
+
+
+def _taint_indices(args: tuple, tainted: Sequence, spec: Dict[int, Optional[int]]
+                   ) -> Dict[int, Dict[int, Optional[int]]]:
+    """Flat-invar taint map: leaves of `args` that are (identically) one of
+    `tainted` get `spec`. Identity matching is exact — builders pass the
+    same array objects they trace with."""
+    import jax
+
+    out: Dict[int, Dict[int, Optional[int]]] = {}
+    for i, leaf in enumerate(jax.tree.leaves(args)):
+        if any(leaf is t for t in tainted):
+            out[i] = dict(spec)
+    return out
+
+
+def _model_state():
+    """One small flagship-architecture model shared by every builder (init
+    runs a handful of tiny CPU programs; cached per process)."""
+    global _STATE_CACHE
+    if _STATE_CACHE is None:
+        import jax
+
+        from iwae_replication_project_tpu.models.iwae import ModelConfig
+        from iwae_replication_project_tpu.training.train_step import (
+            create_train_state)
+        cfg = ModelConfig.two_layer(likelihood="logits")
+        state = create_train_state(jax.random.PRNGKey(0), cfg)
+        _STATE_CACHE = (cfg, state)
+    return _STATE_CACHE
+
+
+_STATE_CACHE = None
+
+
+def build_train_step() -> AuditProgram:
+    """The jitted training step, donation mirroring the driver: donate is
+    gated on donation_safe() exactly as experiment.py gates it, so auditing
+    on a TPU host audits the donating program and on CPU the cache-safe one.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from iwae_replication_project_tpu.objectives import ObjectiveSpec
+    from iwae_replication_project_tpu.training.train_step import (
+        make_train_step)
+    from iwae_replication_project_tpu.utils.compile_cache import donation_safe
+
+    cfg, state = _model_state()
+    step = make_train_step(ObjectiveSpec(name="IWAE", k=8), cfg,
+                           donate=donation_safe())
+    batch = jnp.zeros((16, cfg.x_dim), jnp.float32)
+    return AuditProgram(
+        name="train_step",
+        jaxpr=jax.make_jaxpr(step)(state, batch),
+        sig_args=((state, batch), {}))
+
+
+def build_eval_scorer() -> AuditProgram:
+    """The paper-grade chunked NLL scorer: k=5000 in 250-sample blocks
+    through the online-logsumexp scan carry."""
+    import jax
+    import jax.numpy as jnp
+
+    from iwae_replication_project_tpu.evaluation.metrics import (
+        streaming_log_px)
+
+    cfg, state = _model_state()
+    key = jax.random.PRNGKey(1)
+    x = jnp.zeros((16, cfg.x_dim), jnp.float32)
+
+    def scorer(params, key, x):
+        return streaming_log_px(params, cfg, key, x, k=5000, chunk=250)
+
+    return AuditProgram(
+        name="eval_scorer_k5000",
+        jaxpr=jax.make_jaxpr(scorer)(state.params, key, x),
+        sig_args=((state.params, key, x), {}))
+
+
+def build_serving(op: str) -> AuditProgram:
+    """One serving program at a padded bucket: bucket 8 holding 5 real rows,
+    with the op's declared padded-row kwargs tainted beyond row 5."""
+    import jax
+    import jax.numpy as jnp
+
+    from iwae_replication_project_tpu.serving.programs import (
+        PADDED_ROW_KWARGS,
+        PROGRAMS,
+    )
+
+    cfg, state = _model_state()
+    cfg = dataclasses.replace(cfg, fused_likelihood=False)  # the engine's pin
+    program, takes_k = PROGRAMS[op]
+    bucket, real = 8, 5
+    base_key = jax.random.PRNGKey(2)
+    seeds = jnp.zeros((bucket,), jnp.int32)
+    dim = cfg.n_latent_enc[-1] if op == "decode" else cfg.x_dim
+    payload = jnp.zeros((bucket, dim), jnp.float32)
+    kwargs = {"base_key": base_key, "seeds": seeds,
+              ("h_top" if op == "decode" else "x"): payload}
+    static = {"cfg": cfg, **({"k": 4} if takes_k else {})}
+
+    def fn(params, base_key, seeds, payload):
+        kw = dict(kwargs)
+        kw["base_key"], kw["seeds"] = base_key, seeds
+        kw["h_top" if op == "decode" else "x"] = payload
+        return program(params, **kw, **static)
+
+    args = (state.params, base_key, seeds, payload)
+    tainted = [kwargs[name] for name in PADDED_ROW_KWARGS[op]]
+    return AuditProgram(
+        name=f"serve_{op}",
+        jaxpr=jax.make_jaxpr(fn)(*args),
+        taints=_taint_indices(args, tainted, {0: real}),
+        sig_args=(((state.params,),
+                   tuple(sorted(kwargs.items(), key=lambda kv: kv[0]))), {}))
+
+
+def build_hot_loop(path: str) -> AuditProgram:
+    """One hot-loop path composed with the estimator reduction it feeds
+    (``iwae_per_example``'s logsumexp over k) — the padded-tile dataflow
+    (pad -> kernel -> slice -> logsumexp) is exactly what the taint pass
+    must prove clean. Shape sizes are pairwise distinct (see module doc)."""
+    import jax
+    import jax.numpy as jnp
+
+    from iwae_replication_project_tpu.objectives.estimators import (
+        iwae_per_example)
+    from iwae_replication_project_tpu.ops.hot_loop import decoder_score
+
+    k, b, h1_dim, hid, pix = 12, 24, 20, 40, 30
+    out_params = {
+        "l1": {"w": jnp.zeros((h1_dim, hid)), "b": jnp.zeros((hid,))},
+        "l2": {"w": jnp.zeros((hid, hid)), "b": jnp.zeros((hid,))},
+        "out": {"w": jnp.zeros((hid, pix)), "b": jnp.zeros((pix,))},
+    }
+    x = jnp.zeros((b, pix), jnp.float32)
+    h1 = jnp.zeros((k, b, h1_dim), jnp.float32)
+
+    def fn(out_params, x, h1):
+        lw = decoder_score(out_params, x, h1, on_tpu=False, force_path=path)
+        return iwae_per_example(lw)
+
+    return AuditProgram(
+        name=f"hot_loop_{path}",
+        jaxpr=jax.make_jaxpr(fn)(out_params, x, h1),
+        sig_args=((out_params, x, h1), {}))
+
+
+def build_programs(include: Optional[Sequence[str]] = None
+                   ) -> List[AuditProgram]:
+    """The full audited suite (or the named subset), in PROGRAM_NAMES order."""
+    builders = {
+        "train_step": build_train_step,
+        "eval_scorer_k5000": build_eval_scorer,
+        "serve_score": lambda: build_serving("score"),
+        "serve_encode": lambda: build_serving("encode"),
+        "serve_decode": lambda: build_serving("decode"),
+        "hot_loop_reference": lambda: build_hot_loop("reference"),
+        "hot_loop_blocked_scan": lambda: build_hot_loop("blocked_scan"),
+        "hot_loop_pallas": lambda: build_hot_loop("pallas"),
+    }
+    names = list(include) if include else list(PROGRAM_NAMES)
+    unknown = set(names) - set(builders)
+    if unknown:
+        raise ValueError(f"unknown program(s): {sorted(unknown)}; "
+                         f"known: {sorted(builders)}")
+    from iwae_replication_project_tpu.telemetry.spans import span
+
+    out = []
+    for name in names:
+        with span(f"audit/trace/{name}"):
+            out.append(builders[name]())
+    return out

@@ -1,0 +1,686 @@
+"""Padding-taint dataflow over jaxprs.
+
+The IWAE estimator is an average of ``K`` importance weights through a
+``logsumexp`` (Burda et al., arXiv:1509.00519): ONE unmasked padded weight
+entering that reduction biases the bound silently — ``exp(0) = 1`` is a
+perfectly plausible weight, so nothing NaNs, the number is just wrong. The
+same failure class applies to every padded axis this framework manufactures:
+serving's bucket padding (rows), the hot-loop kernels' tile padding (k,
+batch, pixels), and any future kernel path. PR 6 pinned these with runtime
+parity tests; this pass turns the property into a *static proof obligation*
+on the traced program.
+
+Model: a **taint** is ``{axis: real_extent}`` on an array — indices
+``>= real_extent`` along ``axis`` may be padding (``None`` = unknown, the
+whole axis is suspect). Taint enters a program two ways:
+
+* declared on program inputs (serving programs declare their padded-row
+  kwargs in ``serving/programs.PADDED_ROW_KWARGS``);
+* seeded automatically at every ``pad`` equation — the tile padding inside
+  ``ops/hot_loop.py``/``ops/fused_likelihood.py`` needs no declaration.
+
+Propagation is per-primitive; the two *discharge* rules are
+
+* ``select_n`` whose predicate is a **comparison against an iota** along the
+  tainted axis, with the polarity checked: the case the *padded* region
+  selects (``pred`` False for ``iota < n``-style masks, True for
+  ``iota >= n``-style) must itself be clean on that axis — the
+  ``jnp.where(iota < n, x, neutral)`` masking idiom. A raw iota that never
+  went through a comparison, or an inverted mask that hands padded rows the
+  data operand, discharges nothing. When the comparison bound is a literal
+  it is additionally checked against the taint's real extent (a wrong
+  boundary like ``iota < padded_size`` keeps padded rows and discharges
+  nothing); traced bounds are trusted and counted
+  (``unverified-mask-discharges``); and
+* ``slice`` with ``start 0, limit <= real_extent`` (the ``out[:k, :b]``
+  unpad idiom) clears it exactly.
+
+A **finding** is any combining operation over a still-tainted axis: a
+``reduce_*``, a ``dot_general`` contraction, a ``sort`` (order statistics
+admit padded values), or a ``scan`` whose xs are tainted along the scan axis
+(padded elements fold into the carry).
+
+Known approximations (each deliberately conservative *for this repo's
+program shapes*, and counted on the telemetry registry so drift is visible):
+
+* ``pallas_call`` is opaque — kernel interiors are covered by the runtime
+  parity pins (tests/test_hot_loop.py padding-never-leaks), so outputs
+  inherit operand taint by exact axis-size matching and the XLA-level
+  dataflow around the kernel (pad -> kernel -> slice -> logsumexp) is what
+  gets proven;
+* a reshape that merges a tainted axis taints the merged axis with unknown
+  extent; gather/scatter and unrecognized primitives fall back to a
+  conservative all-axes taint (``default-propagation`` counter).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from iwae_replication_project_tpu.analysis.audit.jaxprs import (
+    core_types,
+    open_jaxpr,
+)
+
+#: axis -> first padded index (None = unknown; the whole axis is suspect)
+Taint = Dict[int, Optional[int]]
+
+#: primitives that are value-wise elementwise over equal-shaped operands
+#: (scalars ride along as rank-0); output taint = axiswise union
+_ELEMENTWISE = {
+    "add", "sub", "mul", "div", "rem", "pow", "max", "min", "atan2",
+    "nextafter", "and", "or", "xor", "not", "shift_left",
+    "shift_right_logical", "shift_right_arithmetic",
+    "eq", "ne", "lt", "le", "gt", "ge",
+    "neg", "sign", "abs", "floor", "ceil", "round", "is_finite",
+    "exp", "exp2", "expm1", "log", "log1p", "tanh", "logistic", "erf",
+    "erfc", "erf_inv", "sqrt", "rsqrt", "cbrt", "square", "integer_pow",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+    "convert_element_type", "stop_gradient", "copy", "clamp",
+    "population_count", "clz", "reduce_precision", "real", "imag",
+}
+
+#: reductions: combining every index of the reduced axes — tainted axis in
+#: `axes` without a prior discharge is THE hazard this pass exists for
+_REDUCES = {"reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+            "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin"}
+
+#: same-shape cumulative ops: corrupted prefix stays inside the padded
+#: region for forward cumulation (taint preserved, not discharged); with
+#: ``reverse=True`` the padded tail accumulates INTO every real row, so the
+#: whole axis becomes suspect (extent -> None, undischargeable by slicing)
+_CUMULATIVE = {"cumsum", "cumprod", "cummax", "cummin", "cumlogsumexp"}
+
+#: elementwise prims a raw iota mark rides through: structural copies ONLY.
+#: Arithmetic (even monotone: add shifts the indices) drops the mark —
+#: a raw mark must mean "this IS the index along that axis" so that a later
+#: literal comparison threshold can be checked against the taint extent
+_IOTA_TRANSPARENT = {"convert_element_type", "copy", "stop_gradient",
+                     "reduce_precision"}
+
+#: comparisons that mint a polarity-carrying mask from a raw iota operand
+_CMP_PRIMS = {"lt", "le", "gt", "ge"}
+
+#: single-sub-jaxpr call-like primitives with 1:1 (or tail-aligned) invars
+_CALL_PRIMS = {"pjit", "closed_call", "core_call", "remat2", "remat",
+               "custom_jvp_call", "custom_jvp_call_jaxpr",
+               "custom_vjp_call", "custom_vjp_call_jaxpr", "shard_map",
+               "checkpoint", "custom_lin"}
+
+
+def _merge_extent(a: Optional[int], b: Optional[int]) -> Optional[int]:
+    return None if a is None or b is None else min(a, b)
+
+
+def _union(*taints: Taint) -> Taint:
+    out: Taint = {}
+    for t in taints:
+        for ax, ext in t.items():
+            out[ax] = _merge_extent(out[ax], ext) if ax in out else ext
+    return out
+
+
+@dataclasses.dataclass
+class TaintStats:
+    """Honesty counters: how often the engine had to approximate."""
+
+    default_propagation: int = 0
+    opaque_calls: int = 0
+    #: select_n discharges whose mask threshold (or taint extent) was a
+    #: traced value the engine could not compare statically — the runtime
+    #: parity pins' jurisdiction, counted so the trust surface is visible
+    unverified_mask_discharges: int = 0
+
+
+class TaintEngine:
+    """One propagation run over one closed jaxpr (recursing into subs).
+
+    `report(location, message)` is called for every unmasked combine over a
+    tainted axis. Findings are deduplicated by (location, message) so scan
+    fixpoint iterations do not multiply them.
+    """
+
+    def __init__(self, report: Callable[[str, str], None]):
+        self._seen: set = set()
+        self._report = report
+        self._quiet = 0  # >0 inside fixpoint warm-up iterations
+        self.stats = TaintStats()
+
+    # -- plumbing -----------------------------------------------------------
+
+    def finding(self, loc: str, msg: str) -> None:
+        if self._quiet:
+            return
+        if (loc, msg) not in self._seen:
+            self._seen.add((loc, msg))
+            self._report(loc, msg)
+
+    @staticmethod
+    def _fmt(t: Taint, axis: int) -> str:
+        ext = t.get(axis)
+        return f"axis {axis} (padding at rows >= {ext})" if ext is not None \
+            else f"axis {axis} (padded region unknown)"
+
+    # -- the walk -----------------------------------------------------------
+
+    def run(self, jaxpr: Any,
+            invar_taints: Dict[int, Taint],
+            invar_iotas: Optional[Dict[int, set]] = None,
+            path: str = "") -> Tuple[List[Taint], List[set]]:
+        """Propagate; returns (taint, iota-axes) per program output."""
+        _, _, Var, Literal = core_types()
+        j = open_jaxpr(jaxpr)
+        taint: Dict[Any, Taint] = {}
+        iota: Dict[Any, set] = {}
+        for i, v in enumerate(j.invars):
+            t = invar_taints.get(i)
+            if t:
+                taint[v] = dict(t)
+            io = (invar_iotas or {}).get(i)
+            if io:
+                iota[v] = set(io)
+
+        def rd(v) -> Taint:
+            return {} if isinstance(v, Literal) else taint.get(v, {})
+
+        def rdi(v) -> set:
+            return set() if isinstance(v, Literal) else iota.get(v, set())
+
+        for i, eqn in enumerate(j.eqns):
+            loc = f"{path}/{eqn.primitive.name}[{i}]" if path \
+                else f"{eqn.primitive.name}[{i}]"
+            outs = self._eqn(eqn, loc, [rd(v) for v in eqn.invars],
+                             [rdi(v) for v in eqn.invars])
+            for v, (t, io) in zip(eqn.outvars, outs):
+                if t:
+                    taint[v] = t
+                if io:
+                    iota[v] = io
+
+        return ([rd(v) for v in j.outvars], [rdi(v) for v in j.outvars])
+
+    # -- iota / mask marks ---------------------------------------------------
+    #
+    # A mark set holds two kinds of element: a bare ``int`` axis (this value
+    # IS the index along that axis — an iota, through structural copies only)
+    # and a tuple ``(axis, polarity, threshold)`` (this bool came from
+    # comparing such an iota: polarity "low" = True exactly on indices
+    # ``< threshold``, i.e. ``iota < n``-shaped; "high" = True exactly on
+    # indices ``>= threshold``, i.e. ``iota >= n``-shaped; threshold is the
+    # comparison's literal bound, or None when it was a traced value). Only
+    # tuple marks can discharge a taint at select_n — with the polarity that
+    # hands the padded region the clean operand, and a threshold that does
+    # not exceed the taint's real extent (a literal bound that keeps padded
+    # rows is a wrong-boundary mask, not a discharge).
+
+    @staticmethod
+    def _raw(marks: set) -> set:
+        return {m for m in marks if not isinstance(m, tuple)}
+
+    @staticmethod
+    def _bool(marks: set) -> set:
+        return {m for m in marks if isinstance(m, tuple)}
+
+    @staticmethod
+    def _literal_int(invar) -> Optional[int]:
+        v = getattr(invar, "val", None)
+        try:
+            return int(v) if v is not None and getattr(
+                v, "shape", ()) in ((), None) and int(v) == v else None
+        except (TypeError, ValueError):
+            return None
+
+    @staticmethod
+    def _remap_marks(marks: set, axmap) -> set:
+        """Re-index every mark's axis through ``axmap`` (None drops it)."""
+        out = set()
+        for m in marks:
+            if isinstance(m, tuple):
+                new = axmap(m[0])
+                if new is not None:
+                    out.add((new,) + m[1:])
+            else:
+                new = axmap(m)
+                if new is not None:
+                    out.add(new)
+        return out
+
+    def _marks(self, eqn, iin: List[set]) -> set:
+        name = eqn.primitive.name
+        if not iin:
+            return set()
+        if name in _CMP_PRIMS and len(iin) == 2:
+            # iota-on-lhs of lt/le is True on low indices; gt/ge flips;
+            # swapping the operands flips again. le/ge shift the exclusive
+            # threshold by one relative to lt/gt
+            lo, hi = (0, 1) if name in ("lt", "le") else (1, 0)
+            both = self._raw(iin[0]) & self._raw(iin[1])
+            out = set()
+            for side, pol in ((lo, "low"), (hi, "high")):
+                axes = self._raw(iin[side]) - both
+                if not axes:
+                    continue
+                thresh = self._literal_int(eqn.invars[1 - side])
+                if thresh is not None and (
+                        (pol == "low" and name in ("le", "ge")) or
+                        (pol == "high" and name in ("lt", "gt"))):
+                    thresh += 1  # inclusive bound -> exclusive threshold
+                out |= {(ax, pol, thresh) for ax in axes}
+            return out
+        if name == "not":
+            return {(ax, "high" if pol == "low" else "low", th)
+                    for ax, pol, th in self._bool(iin[0])}
+        if name == "and":
+            # True only where EVERY operand is: each "low" guarantee (False
+            # past the threshold) survives any conjunction, but a "high"
+            # guarantee (True past it) survives only if ALL operands carry it
+            lows = set().union(*({m for m in self._bool(s) if m[1] == "low"}
+                                 for s in iin))
+            highs = {m for m in self._bool(iin[0]) if m[1] == "high"}
+            for s in iin[1:]:
+                highs &= self._bool(s)
+            return lows | highs
+        if name == "or":
+            # True wherever ANY operand is: the mirror image of "and"
+            highs = set().union(*({m for m in self._bool(s) if m[1] == "high"}
+                                  for s in iin))
+            lows = {m for m in self._bool(iin[0]) if m[1] == "low"}
+            for s in iin[1:]:
+                lows &= self._bool(s)
+            return highs | lows
+        if name in _IOTA_TRANSPARENT:
+            return set().union(*iin)
+        return set()
+
+    # -- per-equation transfer ----------------------------------------------
+
+    def _eqn(self, eqn, loc: str, tin: List[Taint], iin: List[set]
+             ) -> List[Tuple[Taint, set]]:
+        name = eqn.primitive.name
+        n_out = len(eqn.outvars)
+
+        if name == "iota":
+            return [({}, {eqn.params["dimension"]})] * n_out
+
+        if name in _CUMULATIVE:
+            t = _union(*tin)
+            ax = eqn.params.get("axis")
+            if eqn.params.get("reverse") and ax in t:
+                t[ax] = None  # the padded tail folds into every real row
+            return [(t, set())] * n_out
+
+        if name in _ELEMENTWISE:
+            return [(_union(*tin), self._marks(eqn, iin))] * n_out
+
+        if name == "select_n":
+            pred_t, pred_marks = tin[0], iin[0]
+            cases = tin[1:]
+            out = _union(pred_t, *cases)
+            for m in pred_marks:
+                if not isinstance(m, tuple):
+                    continue  # raw iota, never compared: proves nothing
+                ax, pol, thresh = m
+                if ax not in out or ax in pred_t:
+                    continue  # nothing to discharge / predicate itself
+                    #           garbage in the padded region
+                # the case the PADDED region selects must be clean on the
+                # axis (pred False there for 'low' masks, True for 'high')
+                padded_case = cases[0] if pol == "low" else cases[-1]
+                if ax in padded_case:
+                    continue
+                ext = out[ax]
+                if thresh is not None and ext is not None and thresh > ext:
+                    continue  # wrong boundary: the mask keeps padded rows
+                if thresh is None or ext is None:
+                    # traced/unknown bound: discharged on trust, counted
+                    self.stats.unverified_mask_discharges += 1
+                out.pop(ax, None)
+            return [(out, set())] * n_out
+
+        if name in _REDUCES:
+            axes = tuple(eqn.params.get("axes", ()))
+            t = tin[0]
+            for ax in axes:
+                if ax in t:
+                    self.finding(loc, f"{name} over tainted {self._fmt(t, ax)}"
+                                      f" — padded entries enter the reduction"
+                                      f" unmasked")
+            kept = sorted(ax for ax in t if ax not in axes)
+            remap = {ax: ax - sum(1 for r in axes if r < ax) for ax in kept}
+            return [({remap[ax]: t[ax] for ax in kept}, set())] * n_out
+
+        if name == "sort":
+            dim = eqn.params.get("dimension", -1)
+            for t in tin:
+                if dim in t:
+                    self.finding(loc, f"sort along tainted {self._fmt(t, dim)}"
+                                      f" — padded values enter the order "
+                                      f"statistics")
+            return [(_union(*tin), set())] * n_out
+
+        if name == "dot_general":
+            return [self._dot_general(eqn, loc, tin)] * n_out
+
+        if name == "pad":
+            return [(self._pad(eqn, tin[0]), set())] * n_out
+
+        if name == "broadcast_in_dim":
+            bd = eqn.params["broadcast_dimensions"]
+            t = {bd[ax]: ext for ax, ext in tin[0].items() if ax < len(bd)}
+            io = self._remap_marks(iin[0], lambda ax: bd[ax]
+                                   if ax < len(bd) else None)
+            return [(t, io)] * n_out
+
+        if name == "transpose":
+            perm = list(eqn.params["permutation"])
+            t = {perm.index(ax): ext for ax, ext in tin[0].items()}
+            io = self._remap_marks(iin[0], perm.index)
+            return [(t, io)] * n_out
+
+        if name == "reshape":
+            return [(self._reshape(eqn, tin[0]), set())] * n_out
+
+        if name == "squeeze":
+            dims = set(eqn.params["dimensions"])
+            t = {}
+            for ax, ext in tin[0].items():
+                if ax not in dims:
+                    t[ax - sum(1 for d in dims if d < ax)] = ext
+            return [(t, set())] * n_out
+
+        if name == "expand_dims":
+            dims = sorted(eqn.params["dimensions"])
+            t = {}
+            for ax, ext in tin[0].items():
+                new = ax
+                for d in dims:
+                    if d <= new:
+                        new += 1
+                t[new] = ext
+            return [(t, set())] * n_out
+
+        if name == "slice":
+            return [(self._slice(eqn, tin[0]), set())] * n_out
+
+        if name == "concatenate":
+            d = eqn.params["dimension"]
+            out = _union(*tin)
+            if any(d in t for t in tin):
+                out[d] = None  # padding position shifts across the seam
+            return [(out, set())] * n_out
+
+        if name == "rev":
+            dims = set(eqn.params["dimensions"])
+            t = {ax: (None if ax in dims else ext)
+                 for ax, ext in tin[0].items()}
+            return [(t, set())] * n_out
+
+        if name in ("dynamic_slice", "dynamic_update_slice", "gather",
+                    "scatter", "scatter_add", "scatter_max", "scatter_min"):
+            if any(tin):
+                self.stats.default_propagation += 1
+                rank = _rank(eqn.outvars[0])
+                return [({ax: None for ax in range(rank)}, set())] * n_out
+            return [({}, set())] * n_out
+
+        if name == "scan":
+            return self._scan(eqn, loc, tin, iin)
+
+        if name == "while":
+            return self._while(eqn, loc, tin, iin)
+
+        if name == "cond":
+            return self._cond(eqn, loc, tin, iin)
+
+        if name in _CALL_PRIMS:
+            return self._call(eqn, loc, tin, iin)
+
+        if name == "pallas_call":
+            return self._pallas(eqn, tin)
+
+        return self._default(eqn, tin)
+
+    # -- structured handlers ------------------------------------------------
+
+    def _dot_general(self, eqn, loc: str, tin: List[Taint]
+                     ) -> Tuple[Taint, set]:
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        lhs_t, rhs_t = tin[0], tin[1]
+        lhs_rank, rhs_rank = _rank(eqn.invars[0]), _rank(eqn.invars[1])
+        lhs_free = [a for a in range(lhs_rank) if a not in lc and a not in lb]
+        rhs_free = [a for a in range(rhs_rank) if a not in rc and a not in rb]
+        out: Taint = {}
+        for side, t, contract, batch, free, base in (
+                ("lhs", lhs_t, lc, lb, lhs_free, len(lb)),
+                ("rhs", rhs_t, rc, rb, rhs_free, len(lb) + len(lhs_free))):
+            for ax, ext in t.items():
+                if ax in contract:
+                    self.finding(
+                        loc, f"dot_general contracts tainted {side} "
+                             f"{self._fmt(t, ax)} — padded entries are "
+                             f"summed into every output element unmasked")
+                elif ax in batch:
+                    out[list(batch).index(ax)] = _merge_extent(
+                        out.get(list(batch).index(ax), ext), ext)
+                else:
+                    pos = base + free.index(ax)
+                    out[pos] = _merge_extent(out.get(pos, ext), ext)
+        return out, set()
+
+    def _pad(self, eqn, t: Taint) -> Taint:
+        out = dict(t)
+        for ax, (lo, hi, interior) in enumerate(eqn.params["padding_config"]):
+            if lo > 0 or interior > 0:
+                out[ax] = None  # padding at the front / interleaved
+            elif hi > 0:
+                real = _shape(eqn.invars[0])[ax]
+                out[ax] = _merge_extent(out.get(ax, real), real)
+        return out
+
+    def _slice(self, eqn, t: Taint) -> Taint:
+        starts = eqn.params["start_indices"]
+        limits = eqn.params["limit_indices"]
+        strides = eqn.params.get("strides") or (1,) * len(starts)
+        out: Taint = {}
+        for ax, ext in t.items():
+            if strides[ax] != 1 or ext is None:
+                if starts[ax] != 0 or strides[ax] != 1 or \
+                        limits[ax] != _shape(eqn.invars[0])[ax]:
+                    out[ax] = None
+                else:
+                    out[ax] = ext
+                continue
+            if starts[ax] == 0 and limits[ax] <= ext:
+                continue  # the unpad idiom: the padded tail is sliced off
+            new_ext = max(ext - starts[ax], 0)
+            if limits[ax] - starts[ax] > new_ext:
+                out[ax] = new_ext
+            # else fully inside the real region: clean
+        return out
+
+    def _scan(self, eqn, loc: str, tin: List[Taint], iin: List[set]
+              ) -> List[Tuple[Taint, set]]:
+        p = eqn.params
+        nc, nk = p["num_consts"], p["num_carry"]
+        body = p["jaxpr"]
+        consts, carry, xs = tin[:nc], tin[nc:nc + nk], tin[nc + nk:]
+        xs_elt: List[Taint] = []
+        for i, t in enumerate(xs):
+            if 0 in t:
+                self.finding(
+                    loc, f"scan consumes xs operand {i} tainted along the "
+                         f"scan axis ({self._fmt(t, 0)}) — padded elements "
+                         f"fold into the loop carry")
+                xs_elt.append({ax: None for ax in
+                               range(max(_rank(eqn.invars[nc + nk + i]) - 1,
+                                         0))})
+            else:
+                xs_elt.append({ax - 1: ext for ax, ext in t.items()})
+
+        carry_t = [dict(t) for t in carry]
+        self._quiet += 1
+        try:
+            for _ in range(8):  # fixpoint on the carry taint
+                ins = {i: t for i, t in
+                       enumerate(consts + carry_t + xs_elt) if t}
+                outs, _ = self.run(body, ins, path=loc)
+                new_carry = [_union(a, b) for a, b in zip(carry_t, outs[:nk])]
+                if new_carry == carry_t:
+                    break
+                carry_t = new_carry
+        finally:
+            self._quiet -= 1
+        ins = {i: t for i, t in enumerate(consts + carry_t + xs_elt) if t}
+        outs, _ = self.run(body, ins, path=loc)  # reporting pass
+        result = [(t, set()) for t in outs[:nk]]
+        for t in outs[nk:]:  # per-iteration outputs stack along a new axis 0
+            result.append(({ax + 1: ext for ax, ext in t.items()}, set()))
+        return result
+
+    def _while(self, eqn, loc: str, tin: List[Taint], iin: List[set]
+               ) -> List[Tuple[Taint, set]]:
+        p = eqn.params
+        cn, bn = p["cond_nconsts"], p["body_nconsts"]
+        body = p["body_jaxpr"]
+        bconsts = tin[cn:cn + bn]
+        carry_t = [dict(t) for t in tin[cn + bn:]]
+        self._quiet += 1
+        try:
+            for _ in range(8):
+                ins = {i: t for i, t in enumerate(bconsts + carry_t) if t}
+                outs, _ = self.run(body, ins, path=loc)
+                new_carry = [_union(a, b) for a, b in zip(carry_t, outs)]
+                if new_carry == carry_t:
+                    break
+                carry_t = new_carry
+        finally:
+            self._quiet -= 1
+        ins = {i: t for i, t in enumerate(bconsts + carry_t) if t}
+        outs, _ = self.run(body, ins, path=loc)
+        return [(t, set()) for t in outs]
+
+    def _cond(self, eqn, loc: str, tin: List[Taint], iin: List[set]
+              ) -> List[Tuple[Taint, set]]:
+        ops_t = {i: t for i, t in enumerate(tin[1:]) if t}
+        ops_i = {i: io for i, io in enumerate(iin[1:]) if io}
+        merged: Optional[List[Taint]] = None
+        for branch in eqn.params["branches"]:
+            outs, _ = self.run(branch, ops_t, ops_i, path=loc)
+            merged = outs if merged is None else \
+                [_union(a, b) for a, b in zip(merged, outs)]
+        return [(t, set()) for t in (merged or [])]
+
+    def _call(self, eqn, loc: str, tin: List[Taint], iin: List[set]
+              ) -> List[Tuple[Taint, set]]:
+        subs = [v for key in ("jaxpr", "call_jaxpr", "fun_jaxpr")
+                if (v := eqn.params.get(key)) is not None]
+        if not subs:
+            return self._default(eqn, tin)
+        body = subs[0]
+        n_in = len(open_jaxpr(body).invars)
+        # pjit/shard_map align 1:1; const-carrying callers align to the tail
+        offset = len(tin) - n_in
+        ins = {i - offset: t for i, t in enumerate(tin) if t and i >= offset}
+        ios = {i - offset: io for i, io in enumerate(iin)
+               if io and i >= offset}
+        outs, oios = self.run(body, ins, ios, path=loc)
+        return list(zip(outs, oios))
+
+    def _pallas(self, eqn, tin: List[Taint]) -> List[Tuple[Taint, set]]:
+        """Opaque kernel boundary: outputs inherit operand taint by exact
+        axis-size matching (the kernel interior is covered by the runtime
+        parity pins — see the module docstring)."""
+        self.stats.opaque_calls += 1
+        tainted_sizes: Dict[int, Optional[int]] = {}
+        for v, t in zip(eqn.invars, tin):
+            shape = _shape(v)
+            for ax, ext in t.items():
+                size = shape[ax]
+                tainted_sizes[size] = _merge_extent(
+                    tainted_sizes[size], ext) if size in tainted_sizes else ext
+        outs = []
+        for v in eqn.outvars:
+            t = {ax: tainted_sizes[s] for ax, s in enumerate(_shape(v))
+                 if s in tainted_sizes}
+            outs.append((t, set()))
+        return outs
+
+    def _reshape(self, eqn, t: Taint) -> Taint:
+        if not t:
+            return {}
+        old = list(_shape(eqn.invars[0]))
+        new = list(_shape(eqn.outvars[0]))
+        segs = _reshape_segments(old, new)
+        out: Taint = {}
+        for ax, ext in t.items():
+            seg = next((s for s in segs if ax in s[0]), None)
+            if seg and len(seg[0]) == 1 and len(seg[1]) == 1:
+                out[seg[1][0]] = _merge_extent(out.get(seg[1][0], ext), ext)
+            elif seg:
+                for nax in seg[1]:  # merged/split: extent unknowable
+                    out[nax] = None
+            else:
+                for nax in range(len(new)):
+                    out[nax] = None
+        return out
+
+    def _default(self, eqn, tin: List[Taint]) -> List[Tuple[Taint, set]]:
+        """Unknown primitive: preserve taint where the axis size matches at
+        the same position, otherwise go conservative (all axes suspect)."""
+        outs = []
+        for out_v in eqn.outvars:
+            out_shape = _shape(out_v)
+            t: Taint = {}
+            conservative = False
+            for v, tn in zip(eqn.invars, tin):
+                shape = _shape(v)
+                for ax, ext in tn.items():
+                    if ax < len(out_shape) and ax < len(shape) and \
+                            out_shape[ax] == shape[ax]:
+                        t[ax] = _merge_extent(t.get(ax, ext), ext)
+                    else:
+                        conservative = True
+            if conservative:
+                self.stats.default_propagation += 1
+                t = {ax: None for ax in range(len(out_shape))}
+            outs.append((t, set()))
+        return outs
+
+
+def _shape(v) -> Tuple[int, ...]:
+    return tuple(getattr(v.aval, "shape", ()))
+
+
+def _rank(v) -> int:
+    return len(_shape(v))
+
+
+def _reshape_segments(old: List[int], new: List[int]
+                      ) -> List[Tuple[List[int], List[int]]]:
+    """Factor a reshape into minimal (old axes, new axes) segments with equal
+    element products — the 1:1 segments are the axes a taint can ride through
+    exactly."""
+    segs: List[Tuple[List[int], List[int]]] = []
+    i = j = 0
+    while i < len(old) and j < len(new):
+        oi, nj = [i], [j]
+        po, pn = old[i], new[j]
+        i, j = i + 1, j + 1
+        while po != pn:
+            if po < pn:
+                if i >= len(old):
+                    break
+                po *= old[i]
+                oi.append(i)
+                i += 1
+            else:
+                if j >= len(new):
+                    break
+                pn *= new[j]
+                nj.append(j)
+                j += 1
+        segs.append((oi, nj))
+    if i < len(old) or j < len(new):
+        segs.append((list(range(i, len(old))), list(range(j, len(new)))))
+    return segs

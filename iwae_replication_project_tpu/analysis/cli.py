@@ -61,6 +61,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"{name:<{width}}  {rules[name].summary}")
             print(f"{core.BARE_SUPPRESSION:<{width}}  (meta) suppression "
                   f"comment lacks a '-- justification' tail")
+            print(f"{core.USELESS_SUPPRESSION:<{width}}  (meta) suppressed "
+                  f"rule does not fire at the suppression's scope")
             return 0
 
         paths = args.paths or config.paths

@@ -32,9 +32,12 @@ def _default_exclude() -> List[str]:
 
 def _default_hot_paths() -> List[str]:
     # implicit host syncs are hazards where code runs per-step / per-dispatch
+    # (analysis/audit rides along: the auditor only traces, never executes,
+    # so a host sync in IT is a bug too — the analyzer lints the analyzer)
     return ["iwae_replication_project_tpu/training",
             "iwae_replication_project_tpu/parallel",
-            "iwae_replication_project_tpu/ops"]
+            "iwae_replication_project_tpu/ops",
+            "iwae_replication_project_tpu/analysis/audit"]
 
 
 def _default_entry_points() -> List[str]:
@@ -42,7 +45,8 @@ def _default_entry_points() -> List[str]:
     # via the shared helper (utils/compile_cache.setup_persistent_cache) —
     # migrated from tests/test_compile_cache.py's ad-hoc guard
     return ["iwae_replication_project_tpu/experiment.py",
-            "iwae_replication_project_tpu/serving/cli.py", "bench.py",
+            "iwae_replication_project_tpu/serving/cli.py",
+            "iwae_replication_project_tpu/analysis/audit/cli.py", "bench.py",
             "scripts/dress_rehearsal.py", "scripts/warm_start_check.py",
             "__graft_entry__.py"]
 
@@ -55,6 +59,15 @@ def _default_cache_owners() -> List[str]:
 def _default_import_shims() -> List[str]:
     # the only files allowed to import version-fragile jax modules directly
     return ["iwae_replication_project_tpu/parallel/mesh.py"]
+
+
+def _default_concurrency_paths() -> List[str]:
+    # files the concurrency checker (lock-order / unlocked-shared-state)
+    # analyzes: the pipelined serving engine's thread triangle (dispatcher,
+    # completion, metric scrapes) and the registry they all report through
+    return ["iwae_replication_project_tpu/serving/engine.py",
+            "iwae_replication_project_tpu/serving/batcher.py",
+            "iwae_replication_project_tpu/telemetry/registry.py"]
 
 
 def _default_fragile_imports() -> List[str]:
@@ -93,6 +106,9 @@ class LintConfig:
     #: fragile module names (fragile-import rule)
     fragile_imports: List[str] = dataclasses.field(
         default_factory=_default_fragile_imports)
+    #: files the lock-order / unlocked-shared-state rules analyze
+    concurrency_paths: List[str] = dataclasses.field(
+        default_factory=_default_concurrency_paths)
     #: repo root all relative paths above resolve against
     root: Optional[str] = None
 

@@ -27,9 +27,20 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import os
 import re
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Type
+import tokenize
+from typing import (
+    Dict,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+    Type,
+)
 
 from iwae_replication_project_tpu.analysis.config import LintConfig
 
@@ -41,6 +52,10 @@ _SUPPRESS_RE = re.compile(
 
 #: meta-rule id for suppressions missing a justification (not suppressible)
 BARE_SUPPRESSION = "bare-suppression"
+#: meta-rule id for suppressions whose rule would not have fired where they
+#: sit (not suppressible) — keeps the justified-suppression inventory honest
+#: as code moves: a stale suppression is a pre-authorized future hazard
+USELESS_SUPPRESSION = "useless-suppression"
 #: pseudo-rule id for files the parser rejects
 PARSE_ERROR = "parse-error"
 
@@ -150,14 +165,14 @@ class Suppression:
     justified: bool
 
     def covers(self, rule: str) -> bool:
-        return rule != BARE_SUPPRESSION and \
+        return rule not in (BARE_SUPPRESSION, USELESS_SUPPRESSION) and \
             ("all" in self.rules or rule in self.rules)
 
 
 def parse_suppressions(source: str) -> List[Suppression]:
     out: List[Suppression] = []
-    for i, line in enumerate(source.splitlines(), start=1):
-        m = _SUPPRESS_RE.search(line)
+    for i, text in _comments(source):
+        m = _SUPPRESS_RE.search(text)
         if m is None:
             continue
         rules = [r.strip() for r in m.group("rules").split(",") if r.strip()]
@@ -167,29 +182,92 @@ def parse_suppressions(source: str) -> List[Suppression]:
     return out
 
 
+def _comments(source: str) -> List[Tuple[int, str]]:
+    """``(lineno, text)`` for every real COMMENT token. Tokenizing (instead
+    of a per-line regex) keeps suppression grammar shown inside docstrings —
+    this module's own, for one — from parsing as live suppressions."""
+    try:
+        return [(tok.start[0], tok.string)
+                for tok in tokenize.generate_tokens(io.StringIO(source)
+                                                    .readline)
+                if tok.type == tokenize.COMMENT]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # un-tokenizable source never reaches the rules either (parse-error);
+        # fall back to the line scan so suppression *parsing* stays total
+        return list(enumerate(source.splitlines(), start=1))
+
+
 def apply_suppressions(findings: Iterable[Finding], sups: List[Suppression],
-                       rel_path: str) -> List[Finding]:
+                       rel_path: str,
+                       active_rules: Optional[set] = None,
+                       complete_run: bool = False,
+                       known_rules: Optional[set] = None) -> List[Finding]:
     """Drop suppressed findings; add a ``bare-suppression`` finding for every
-    suppression comment with no ``-- justification`` tail."""
-    file_rules = [s for s in sups if s.file_scope]
-    by_line: Dict[int, List[Suppression]] = {}
-    for s in sups:
+    suppression comment with no ``-- justification`` tail, and a
+    ``useless-suppression`` finding for every suppressed rule that did not
+    actually fire at the suppression's scope.
+
+    `active_rules` is the set of rule names that RAN on this file: a token
+    is only judged useless when its rule had the chance to fire (a
+    ``--select`` subset must not condemn the other rules' suppressions).
+    An ``all`` token can only be judged when EVERY registered rule ran
+    (`complete_run`): under any subset, a rule the subset skipped may be
+    what the suppression exists for. A token naming NO registered rule at
+    all (`known_rules`: misspelled, or the rule was renamed/removed) is
+    reported unconditionally — it can never become live, so no run subset
+    can vindicate it.
+    """
+    file_rules = [(i, s) for i, s in enumerate(sups) if s.file_scope]
+    by_line: Dict[int, List[Tuple[int, Suppression]]] = {}
+    for i, s in enumerate(sups):
         if not s.file_scope:
-            by_line.setdefault(s.line, []).append(s)
+            by_line.setdefault(s.line, []).append((i, s))
+    used: List[set] = [set() for _ in sups]
 
     kept: List[Finding] = []
     for f in findings:
-        if any(s.covers(f.rule) for s in file_rules):
-            continue
-        if any(s.covers(f.rule) for s in by_line.get(f.line, [])):
-            continue
-        kept.append(f)
-    for s in sups:
+        matched = False
+        for i, s in file_rules:
+            if s.covers(f.rule):
+                used[i].add(f.rule)
+                matched = True
+        for i, s in by_line.get(f.line, []):
+            if s.covers(f.rule):
+                used[i].add(f.rule)
+                matched = True
+        if not matched:
+            kept.append(f)
+    for i, s in enumerate(sups):
         if not s.justified:
             kept.append(Finding(
                 path=rel_path, line=s.line, col=0, rule=BARE_SUPPRESSION,
                 message="suppression has no justification; write "
                         "'# iwaelint: disable=<rule> -- <why this is safe>'"))
+        for token in s.rules:
+            if token == "all":
+                if complete_run and not used[i]:
+                    kept.append(Finding(
+                        path=rel_path, line=s.line, col=0,
+                        rule=USELESS_SUPPRESSION,
+                        message="'disable=all' suppresses nothing here — "
+                                "no rule fires at this scope; remove it"))
+            elif known_rules is not None and token not in known_rules:
+                kept.append(Finding(
+                    path=rel_path, line=s.line, col=0,
+                    rule=USELESS_SUPPRESSION,
+                    message=f"suppression names unknown rule '{token}' — "
+                            f"misspelled or removed; it can never fire, so "
+                            f"this suppression suppresses nothing"))
+            elif active_rules is not None and token in active_rules \
+                    and token not in used[i]:
+                scope = "file" if s.file_scope else "line"
+                kept.append(Finding(
+                    path=rel_path, line=s.line, col=0,
+                    rule=USELESS_SUPPRESSION,
+                    message=f"suppression of '{token}' is useless: the rule "
+                            f"does not fire on this {scope} — remove it (a "
+                            f"stale suppression silently pre-authorizes the "
+                            f"next real violation here)"))
     return kept
 
 
@@ -241,8 +319,12 @@ def lint_file(path: str, config: LintConfig, root: Optional[str] = None,
     findings: List[Finding] = []
     for rule in active.values():
         findings.extend(rule.check(ctx))
-    findings = apply_suppressions(findings, parse_suppressions(source),
-                                  ctx.rel_path)
+    findings = apply_suppressions(
+        findings, parse_suppressions(source), ctx.rel_path,
+        active_rules=set(active),
+        complete_run=set(active) == set(all_rules()),
+        known_rules=(set(all_rules()) |
+                     {BARE_SUPPRESSION, USELESS_SUPPRESSION, PARSE_ERROR}))
     # one finding per (rule, location): visitors that re-walk loop bodies to
     # model second iterations would otherwise duplicate
     return sorted(set(findings))

@@ -2,6 +2,7 @@
 the core registry (``core.all_rules`` triggers the import)."""
 
 from iwae_replication_project_tpu.analysis.rules import (  # noqa: F401
+    concurrency,
     dtype,
     entrypoints,
     host,

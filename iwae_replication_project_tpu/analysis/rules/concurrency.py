@@ -1,0 +1,286 @@
+"""Rules ``lock-order`` and ``unlocked-shared-state``: the serving
+concurrency checker.
+
+The serving engine is a three-thread system — the dispatcher coalesces and
+enqueues, the completion thread fetches and completes, and metric scrapes
+read from arbitrary threads (Prometheus endpoint, bench loops). The two
+failure classes that matter there are classic: two locks taken in opposite
+orders on two paths (deadlock under the right interleaving — which closed-
+loop serving traffic will eventually find), and an attribute that is
+guarded on one path and bare on another (a torn/stale publish under the
+GIL's instruction-level interleaving). Both are *cross-function* properties
+no unit test reliably catches, so they are checked statically over a small
+CFG walk of the configured ``concurrency_paths`` (serving/engine.py,
+serving/batcher.py, telemetry/registry.py).
+
+Model (deliberately scoped to this codebase's locking idiom):
+
+* a **lock attribute** is ``self.X`` assigned from
+  ``threading.Lock/RLock/Condition/(Bounded)Semaphore`` anywhere in the
+  class, or assigned from a parameter named ``lock`` (the registry's shared-
+  lock pattern). ``threading.Condition(self.Y)`` ALIASES Y — the engine's
+  ``_cv``/``_lock`` pair is one lock, not two;
+* an **acquisition** is ``with self.X:`` (the only form these modules use);
+* analysis is per class: edges ``held -> acquired`` from nested with-blocks
+  plus one level of same-class method calls made while holding a lock; a
+  cycle in that graph is a ``lock-order`` finding at each participating
+  acquisition site;
+* a write (``self.Y = ...``, ``self.Y op= ...``, ``self.Y[...] = ...``, or
+  a mutating method call ``self.Y.append/pop/...(...)``) is **guarded** when
+  it executes under any ``with self.<lock>``; an attribute with both guarded
+  and bare writes outside ``__init__`` gets an ``unlocked-shared-state``
+  finding at each bare site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from iwae_replication_project_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    register,
+)
+
+#: threading factory callables whose result is a lockable
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+#: container methods that mutate their receiver in place
+_MUTATORS = {"append", "appendleft", "extend", "insert", "pop", "popleft",
+             "remove", "clear", "update", "add", "discard", "setdefault",
+             "sort", "reverse"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> ``"X"`` (None otherwise)."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _in_paths(ctx: FileContext, paths: List[str]) -> bool:
+    return any(ctx.rel_path == p or ctx.rel_path.startswith(p.rstrip("/") + "/")
+               for p in paths)
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Dict[str, str]:
+    """Lock attribute -> canonical lock name (Condition aliases collapse)."""
+    canon: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        attr = _self_attr(node.targets[0])
+        if attr is None:
+            continue
+        for call in [n for n in ast.walk(node.value)
+                     if isinstance(n, ast.Call)]:
+            name = Rule.terminal(Rule.call_name(call))
+            if name in _LOCK_FACTORIES:
+                alias = None
+                if name == "Condition" and call.args:
+                    alias = _self_attr(call.args[0])
+                canon[attr] = canon.get(alias, alias) if alias else attr
+                break
+        else:
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id.endswith("lock"):
+                canon[attr] = attr  # shared-lock injection (registry pattern)
+    return canon
+
+
+class _FuncWalk(ast.NodeVisitor):
+    """One function's lock behavior: acquisition edges, per-lock acquisition
+    sites, writes (guarded or bare), and same-class calls under a lock."""
+
+    def __init__(self, locks: Dict[str, str]):
+        self.locks = locks
+        self.held: List[str] = []
+        #: (held_lock, acquired_lock, node) for nested acquisitions
+        self.edges: List[Tuple[str, str, ast.AST]] = []
+        #: canonical lock -> first acquisition node (for reporting)
+        self.acquired: Dict[str, ast.AST] = {}
+        #: attr -> [(guarded?, node)]
+        self.writes: Dict[str, List[Tuple[bool, ast.AST]]] = {}
+        #: (held_lock, method_name) calls for one-level interprocedural edges
+        self.calls_under_lock: List[Tuple[str, str]] = []
+
+    def _record_write(self, attr: str, node: ast.AST) -> None:
+        self.writes.setdefault(attr, []).append((bool(self.held), node))
+
+    def visit_With(self, node: ast.With) -> None:
+        entered: List[str] = []
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr in self.locks and item.optional_vars is None:
+                lock = self.locks[attr]
+                self.acquired.setdefault(lock, item.context_expr)
+                for held in self.held:
+                    if held != lock:
+                        self.edges.append((held, lock, item.context_expr))
+                self.held.append(lock)
+                entered.append(lock)
+            else:
+                self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in entered:
+            self.held.pop()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            base = tgt.value if isinstance(tgt, ast.Subscript) else tgt
+            attr = _self_attr(base)
+            if attr is not None and attr not in self.locks:
+                self._record_write(attr, tgt)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        base = node.target.value if isinstance(node.target, ast.Subscript) \
+            else node.target
+        attr = _self_attr(base)
+        if attr is not None and attr not in self.locks:
+            self._record_write(attr, node.target)
+        self.visit(node.value)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute):
+            recv = node.func.value
+            attr = _self_attr(recv)
+            if attr is not None and node.func.attr in _MUTATORS and \
+                    attr not in self.locks:
+                self._record_write(attr, node)
+            if isinstance(recv, ast.Name) and recv.id == "self" and self.held:
+                for held in self.held:
+                    self.calls_under_lock.append((held, node.func.attr))
+        self.generic_visit(node)
+
+
+def _path(adj: Dict[str, Set[str]], src: str, dst: str
+          ) -> Optional[List[str]]:
+    """BFS path ``src -> ... -> dst`` through held->acquired edges (None if
+    unreachable); the caller prepends the edge that closes the cycle."""
+    frontier, parents = [src], {src: None}
+    while frontier:
+        nxt: List[str] = []
+        for n in frontier:
+            if n == dst:
+                path = []
+                while n is not None:
+                    path.append(n)
+                    n = parents[n]
+                return path[::-1]
+            for m in adj.get(n, ()):
+                if m not in parents:
+                    parents[m] = n
+                    nxt.append(m)
+        frontier = nxt
+    return None
+
+
+def _analyze_class(cls: ast.ClassDef):
+    locks = _lock_attrs(cls)
+    walks: Dict[str, _FuncWalk] = {}
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            w = _FuncWalk(locks)
+            for stmt in item.body:
+                w.visit(stmt)
+            walks[item.name] = w
+    return locks, walks
+
+
+@register
+class LockOrderRule(Rule):
+    name = "lock-order"
+    summary = ("locks acquired in a cyclic order (direct inversion or a "
+               "longer cycle) across paths of a concurrency_paths class — "
+               "a deadlock under the right thread interleaving")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _in_paths(ctx, ctx.config.concurrency_paths):
+            return
+        for cls in [n for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            locks, walks = _analyze_class(cls)
+            if len(set(locks.values())) < 2:
+                continue  # one canonical lock cannot invert
+            # direct nested-with edges + one level of held-lock method calls
+            edges: Dict[Tuple[str, str], ast.AST] = {}
+            for w in walks.values():
+                for held, got, node in w.edges:
+                    edges.setdefault((held, got), node)
+                for held, meth in w.calls_under_lock:
+                    callee = walks.get(meth)
+                    if callee is None:
+                        continue
+                    for got, node in callee.acquired.items():
+                        if got != held:
+                            edges.setdefault((held, got), node)
+            adj: Dict[str, Set[str]] = {}
+            for a, b in edges:
+                adj.setdefault(a, set()).add(b)
+            for (a, b), node in sorted(edges.items()):
+                cycle = _path(adj, b, a)  # edge on a cycle iff b reaches a
+                if cycle is None:
+                    continue
+                if (b, a) in edges:
+                    if a < b:  # report each direct inversion once
+                        other = edges[(b, a)]
+                        yield ctx.finding(
+                            self.name, node,
+                            f"'{cls.name}' acquires lock '{b}' while "
+                            f"holding '{a}' here, but the opposite order at "
+                            f"line {getattr(other, 'lineno', '?')} — two "
+                            f"threads taking the pair concurrently deadlock;"
+                            f" pick one global order")
+                else:  # longer cycle: every edge inside it is a hold point
+                    chain = " -> ".join([a] + cycle)
+                    yield ctx.finding(
+                        self.name, node,
+                        f"'{cls.name}' acquires lock '{b}' while holding "
+                        f"'{a}' here, closing the cyclic lock order "
+                        f"{chain} — threads advancing around the cycle "
+                        f"concurrently deadlock; pick one global order")
+
+
+@register
+class UnlockedSharedStateRule(Rule):
+    name = "unlocked-shared-state"
+    summary = ("attribute written both under a lock and bare in a "
+               "concurrency_paths class — the bare write races the guarded "
+               "readers/writers")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _in_paths(ctx, ctx.config.concurrency_paths):
+            return
+        for cls in [n for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            locks, walks = _analyze_class(cls)
+            if not locks:
+                continue  # lock-free classes are synchronized by their owner
+            guarded: Set[str] = set()
+            for name, w in walks.items():
+                if name in ("__init__", "__post_init__"):
+                    continue
+                for attr, sites in w.writes.items():
+                    if any(g for g, _ in sites):
+                        guarded.add(attr)
+            for name, w in walks.items():
+                if name in ("__init__", "__post_init__"):
+                    continue
+                for attr, sites in w.writes.items():
+                    if attr not in guarded:
+                        continue
+                    for g, node in sites:
+                        if not g:
+                            yield ctx.finding(
+                                self.name, node,
+                                f"'{cls.name}.{attr}' is written under a "
+                                f"lock elsewhere but bare in '{name}' — "
+                                f"either every write holds the lock or none "
+                                f"does; a mixed regime publishes torn/stale "
+                                f"state to the guarded threads")

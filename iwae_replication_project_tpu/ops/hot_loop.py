@@ -545,11 +545,14 @@ _fused_block_ll.defvjp(_fused_fwd, _fused_bwd)
 # --------------------------------------------------------------------------
 
 def select_path(k: int, b: int, h1_dim: int, hid: int, n_pixels: int, *,
-                on_tpu: bool, compute_dtype=None
+                on_tpu: bool, compute_dtype=None,
+                force: Optional[str] = None
                 ) -> Tuple[str, Optional[Tuple[int, int]]]:
     """``(path, pallas_block_or_None)`` for one hot-loop shape.
 
-    Order: env override > Pallas (probe-gated; interpret mode only when
+    Order: explicit `force` (callers that must trace ONE specific path —
+    the program auditor enumerates all three without mutating the process
+    env) > env override > Pallas (probe-gated; interpret mode only when
     forced, so CPU production never pays the interpreter) > blocked scan
     when the materialized working set crosses the threshold > reference.
     Runs at trace time only — the choice is baked into the compiled program,
@@ -557,10 +560,11 @@ def select_path(k: int, b: int, h1_dim: int, hid: int, n_pixels: int, *,
     """
     from iwae_replication_project_tpu.telemetry.spans import span
 
-    forced = os.environ.get("IWAE_HOT_LOOP_PATH", "auto").lower()
+    forced = (force or os.environ.get("IWAE_HOT_LOOP_PATH", "auto")).lower()
     if forced not in ("auto", "pallas", "blocked_scan", "reference"):
+        source = "force argument" if force else "IWAE_HOT_LOOP_PATH"
         raise ValueError(
-            f"IWAE_HOT_LOOP_PATH={forced!r}: expected auto | pallas | "
+            f"{source}={forced!r}: expected auto | pallas | "
             f"blocked_scan | reference")
     if forced == "pallas" or (forced == "auto" and on_tpu):
         with span("kernel/select/pallas"):
@@ -587,7 +591,8 @@ def select_path(k: int, b: int, h1_dim: int, hid: int, n_pixels: int, *,
 
 
 def decoder_score(out_params, x, h1, *, compute_dtype=None,
-                  on_tpu: bool = False) -> jnp.ndarray:
+                  on_tpu: bool = False,
+                  force_path: Optional[str] = None) -> jnp.ndarray:
     """``log p(x | h1)`` summed over pixels -> ``[k, B]``, hot-loop-blocked.
 
     `out_params` is the models.mlp output block pytree (``l1``/``l2``/``out``
@@ -595,7 +600,9 @@ def decoder_score(out_params, x, h1, *, compute_dtype=None,
     bottom latent. The decoder intermediates (two ``[k, B, hid]`` hiddens
     and the ``[k, B, D]`` logits) never materialize at full k on the pallas
     and blocked-scan paths. Selection happens here, at trace time, and is
-    recorded on the telemetry registry.
+    recorded on the telemetry registry. `force_path` pins one implementation
+    regardless of env/shape (the program auditor traces every path this way;
+    production callers leave it None).
     """
     w1, b1 = out_params["l1"]["w"], out_params["l1"]["b"]
     w2, b2 = out_params["l2"]["w"], out_params["l2"]["b"]
@@ -605,7 +612,7 @@ def decoder_score(out_params, x, h1, *, compute_dtype=None,
     n_pixels = w3.shape[-1]
     cd = None if compute_dtype is None else jnp.dtype(compute_dtype).name
     path, block = select_path(k, b, h1_dim, hid, n_pixels, on_tpu=on_tpu,
-                              compute_dtype=cd)
+                              compute_dtype=cd, force=force_path)
     _record_path(path)
     if path == "pallas":
         return _fused_block_ll(h1, w1, b1, w2, b2, w3, b3, x,

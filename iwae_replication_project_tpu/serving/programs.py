@@ -76,3 +76,17 @@ PROGRAMS = {
     "encode": (encode_rows, True),
     "decode": (decode_rows, False),
 }
+
+#: op name -> kwargs whose leading axis carries zero-filled padding rows
+#: beyond the real batch (the engine pads every dispatch to a bucket rung
+#: and slices afterwards). This is the audit contract: the padding-taint
+#: pass (analysis/audit) seeds row-taint on axis 0 of exactly these inputs
+#: and statically proves no padded row can reach a reduction unmasked —
+#: the jaxpr-level form of the row-independence invariant the padded-bucket
+#: parity tests pin at runtime. A new serving op MUST declare its padded
+#: inputs here or the auditor will not see its padding at all.
+PADDED_ROW_KWARGS = {
+    "score": ("seeds", "x"),
+    "encode": ("seeds", "x"),
+    "decode": ("seeds", "h_top"),
+}

@@ -52,7 +52,12 @@ class Counter:
 
     @property
     def value(self) -> Union[int, float]:
-        return self._v
+        # scrape-side reads hold the same lock the writers hold — the
+        # discipline analysis/rules/concurrency.py enforces on this module
+        # (a bare read is benign for a float in CPython, but the mixed
+        # regime is exactly what the checker exists to keep out)
+        with self._lock:
+            return self._v
 
 
 class Gauge:
@@ -70,7 +75,8 @@ class Gauge:
 
     @property
     def value(self) -> float:
-        return self._v
+        with self._lock:  # lock-consistent read; see Counter.value
+            return self._v
 
 
 class Histogram:
@@ -118,6 +124,12 @@ class Histogram:
     def percentile(self, q: float) -> Optional[float]:
         """Upper bound of the bin holding the q-quantile (q in [0, 1]),
         clamped by the exact observed max."""
+        with self._lock:
+            return self._percentile(q)
+
+    def _percentile(self, q: float) -> Optional[float]:
+        # caller holds self._lock: counts/n/vmax are read as one consistent
+        # state (the writers mutate them together under the same lock)
         if self.n == 0:
             return None
         target = q * self.n
@@ -129,12 +141,13 @@ class Histogram:
         return self.vmax
 
     def summary(self) -> Dict[str, Optional[float]]:
-        mean = self.total / self.n if self.n else None
-        return {"count": self.n, "mean": mean,
-                "p50": self.percentile(0.50),
-                "p95": self.percentile(0.95),
-                "p99": self.percentile(0.99),
-                "max": self.vmax if self.n else None}
+        with self._lock:  # one consistent view across count/mean/percentiles
+            mean = self.total / self.n if self.n else None
+            return {"count": self.n, "mean": mean,
+                    "p50": self._percentile(0.50),
+                    "p95": self._percentile(0.95),
+                    "p99": self._percentile(0.99),
+                    "max": self.vmax if self.n else None}
 
 
 class MetricRegistry:

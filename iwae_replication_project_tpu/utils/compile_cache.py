@@ -209,11 +209,17 @@ def donation_safe() -> bool:
 # ---------------------------------------------------------------------------
 
 def _abstract_signature(args: Tuple) -> Tuple:
-    """Hashable (treedef, per-leaf shape/dtype/sharding) fingerprint of a call.
+    """Hashable (treedef, per-leaf shape/dtype/sharding/weak) fingerprint of
+    a call.
 
     Shardings are part of the signature: the same pytree placed under a
     different mesh (or re-placed single-device) must map to its own
-    executable, not be fed to one compiled for other devices.
+    executable, not be fed to one compiled for other devices. Weak-typedness
+    is part of it too — a weak-typed leaf traces a different program than its
+    committed twin, so folding them into one slot would hand one caller the
+    other's executable. The leaf grammar (array 4-tuple vs python-scalar
+    2-tuple) is what analysis/audit's recompile-cardinality pass walks when
+    it flags signatures that fragment this registry.
     """
     import jax
 
@@ -223,10 +229,24 @@ def _abstract_signature(args: Tuple) -> Tuple:
         if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
             sharding = getattr(leaf, "sharding", None)
             sig.append((tuple(leaf.shape), str(leaf.dtype),
-                        str(sharding) if sharding is not None else ""))
+                        str(sharding) if sharding is not None else "",
+                        bool(getattr(leaf, "weak_type", False))))
         else:  # python scalar etc. — weak-typed; key on type + value
             sig.append((type(leaf).__name__, repr(leaf)))
     return (str(treedef), tuple(sig))
+
+
+def registry_signatures() -> list:
+    """``(name, build_key, signature)`` for every registered executable.
+
+    The audit CLI's recompile-cardinality pass walks these to flag python-
+    scalar and weak-typed signature leaves — each of which mints one
+    executable per distinct value and fragments this registry under serving
+    traffic.
+    """
+    with _lock:
+        return [(name, build_key, sig)
+                for (name, build_key, sig) in _executables]
 
 
 def _registry_get_or_compile(name: str, jitted_fn: Callable, args: Tuple,

@@ -1,88 +1,160 @@
-"""The single CI gate: static lint, then tier-1 tests (with sanitizers).
+"""The single CI gate: lint -> audit -> smokes -> tier-1, with a
+machine-readable summary.
 
 ``python scripts/check.py`` runs, in order:
 
 1. **iwaelint** over the production tree (``[tool.iwaelint]`` paths) — the
-   8-rule JAX correctness suite (analysis/), including the ``cache-setup``
-   guard on every entry point (the ``iwae-serve`` CLI among them);
-2. **telemetry smoke** (scripts/telemetry_smoke.py) — registry export,
-   span nesting, jitted ESS identities, and all three exporter surfaces
-   (JSONL/TB, Prometheus text, /metrics HTTP) under ``JAX_PLATFORMS=cpu``;
-3. **serving smoke** (scripts/serving_smoke.py) — the pipelined dispatch
-   path on a warm engine under a ragged burst: zero recompiles after
-   warmup, zero lost futures through a mid-burst ``stop()``, in-flight
-   window drained;
-4. **hot-loop smoke** (scripts/hot_loop_smoke.py) — interpret-mode parity
-   of the blocked (k, batch) kernel (fwd + grads), bitwise blocked-scan
-   fallback, forced-path dispatch parity with kernel_path telemetry, and
-   the one-probe-per-shape cache;
-5. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with ``--sanitize``
-   armed, so the marked subset additionally runs under
-   ``jax.transfer_guard("disallow")`` + ``jax.debug_nans``. The serving
-   subsystem's fast tests (tests/test_serving.py: batcher policy,
-   padded-bucket parity, shed/timeout robustness, warm-path zero-compile)
-   ride this stage; only the end-to-end synthetic load sweep is ``slow``
-   (run it via ``pytest -m slow tests/test_serving.py`` or
-   ``bench.py --serving``).
+   AST rule suite (analysis/rules/), including the concurrency checker
+   (lock-order / unlocked-shared-state over the serving engine and the
+   metric registry) and the ``useless-suppression`` meta-rule;
+2. **iwae-audit** (analysis/audit/) — the jaxpr-level program auditor:
+   donation safety, padding taint, in-graph host transfers, and recompile
+   cardinality over the repo's real traced programs (train step, k=5000
+   eval scorer, the three serving programs, all hot-loop paths);
+3. **telemetry smoke** (scripts/telemetry_smoke.py);
+4. **serving smoke** (scripts/serving_smoke.py);
+5. **hot-loop smoke** (scripts/hot_loop_smoke.py);
+6. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
+   ``--sanitize`` armed.
 
-Exit status is nonzero if EITHER stage fails; the lint stage does not
-short-circuit the test stage (CI reports both). ``--lint-only`` /
-``--tests-only`` select a single stage; extra args after ``--`` are passed
-through to pytest.
+Every full-gate run writes ``results/check_summary.json`` (per-stage status,
+exit code, wall time, and — for the analyzers — finding counts) so CI and
+the bench rounds can diff gate results across PRs instead of scraping logs.
+Single-stage runs (``--lint-only`` / ``--tests-only``) skip the default
+write — a partial record must never clobber, or pose as, the full-gate one
+— but honor an explicit ``--summary`` path.
+
+Analyzer exit codes are *classified*, not just tested for nonzero: the lint
+and audit CLIs exit **1** for findings and **2** for internal errors, and
+the summary records ``findings`` vs ``internal-error`` accordingly — an
+analyzer crash must never masquerade as (or hide behind) a findings list.
+Either fails the gate. The stages do not short-circuit each other; exit
+status is nonzero if ANY stage fails. ``--lint-only`` / ``--tests-only``
+select a single stage; extra args after ``--`` pass through to pytest.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_lint() -> int:
-    print("== iwaelint: static analysis ".ljust(72, "="))
-    return subprocess.call(
-        [sys.executable, "-m", "iwae_replication_project_tpu.analysis"],
-        cwd=REPO)
-
-
-def run_telemetry_smoke() -> int:
-    print("== telemetry smoke: registry export + span nesting ".ljust(72, "="))
+def _cpu_env() -> dict:
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
-    return subprocess.call(
-        [sys.executable, os.path.join("scripts", "telemetry_smoke.py")],
-        cwd=REPO, env=env)
+    return env
 
 
-def run_serving_smoke() -> int:
-    print("== serving smoke: pipelined dispatch, warm engine ".ljust(72, "="))
-    env = dict(os.environ)
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    return subprocess.call(
-        [sys.executable, os.path.join("scripts", "serving_smoke.py")],
-        cwd=REPO, env=env)
+def classify_analyzer_rc(rc: int) -> str:
+    """Map an analyzer CLI's exit code onto a summary status. 0 = clean,
+    1 = findings; ANYTHING else is the analyzer itself failing (exit 2 is
+    the CLIs' declared internal-error code, and a signal/exception exit is
+    equally not a findings list) — treating those as findings would report
+    a crashed analyzer as a lint problem and hide the crash."""
+    if rc == 0:
+        return "ok"
+    if rc == 1:
+        return "findings"
+    return "internal-error"
 
 
-def run_hot_loop_smoke() -> int:
-    print("== hot-loop smoke: blocked kernel parity + probe cache ".ljust(72, "="))
-    env = dict(os.environ)
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    return subprocess.call(
-        [sys.executable, os.path.join("scripts", "hot_loop_smoke.py")],
-        cwd=REPO, env=env)
+def run_analyzer(label: str, module: str) -> dict:
+    """Run a findings-producing CLI with ``--format json``, classify its
+    exit code, and re-print its findings human-readably.
+
+    The analyzers inherit the HOST environment (no CPU pin): the audit is
+    env-sensitive by design — on a TPU host the train step traces its
+    donating variant and donation-safety audits the real program; pinning
+    CPU here would make the gate audit a program production never runs.
+    The smoke/test stages keep the CPU pin (their fixtures force it anyway).
+    """
+    print(f"== {label} ".ljust(72, "="))
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", module, "--format", "json"],
+        cwd=REPO, capture_output=True, text=True)
+    wall = time.perf_counter() - t0
+    status = classify_analyzer_rc(proc.returncode)
+    counts, total = {}, None
+    if status == "internal-error":
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        print(f"{label}: INTERNAL ERROR (rc={proc.returncode}) — the "
+              f"analyzer crashed; this is NOT a findings failure")
+    else:
+        try:
+            payload = json.loads(proc.stdout)
+            counts = payload.get("counts", {})
+            total = payload.get("total", 0)
+            for f in payload.get("findings", []):
+                loc = f.get("path") or f.get("program", "?")
+                line = f.get("line")
+                at = f.get("location") or (f"{line}:{f.get('col', 0)}"
+                                           if line is not None else "")
+                print(f"{loc}:{at}: [{f['rule']}] {f['message']}")
+            print(f"{label}: {'clean' if total == 0 else f'{total} finding(s)'}")
+        except (json.JSONDecodeError, KeyError) as e:
+            status = "internal-error"
+            sys.stdout.write(proc.stdout)
+            sys.stderr.write(proc.stderr)
+            print(f"{label}: unparseable analyzer output ({e})")
+    return {"name": label, "status": status, "rc": proc.returncode,
+            "wall_seconds": round(wall, 3), "findings": total,
+            "counts": counts}
 
 
-def run_tests(extra) -> int:
-    print("== pytest: tier-1 (fast profile) + sanitizers ".ljust(72, "="))
-    env = dict(os.environ)
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    cmd = [sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
-           "--sanitize", "-p", "no:cacheprovider",
-           "--continue-on-collection-errors"] + list(extra)
-    return subprocess.call(cmd, cwd=REPO, env=env)
+def run_step(label: str, cmd: list) -> dict:
+    print(f"== {label} ".ljust(72, "="))
+    t0 = time.perf_counter()
+    rc = subprocess.call(cmd, cwd=REPO, env=_cpu_env())
+    return {"name": label, "status": "ok" if rc == 0 else "failed",
+            "rc": rc, "wall_seconds": round(time.perf_counter() - t0, 3)}
+
+
+def run_lint() -> dict:
+    return run_analyzer("lint", "iwae_replication_project_tpu.analysis")
+
+
+def run_audit() -> dict:
+    return run_analyzer("audit", "iwae_replication_project_tpu.analysis.audit")
+
+
+def run_telemetry_smoke() -> dict:
+    return run_step("telemetry smoke",
+                    [sys.executable, os.path.join("scripts",
+                                                  "telemetry_smoke.py")])
+
+
+def run_serving_smoke() -> dict:
+    return run_step("serving smoke",
+                    [sys.executable, os.path.join("scripts",
+                                                  "serving_smoke.py")])
+
+
+def run_hot_loop_smoke() -> dict:
+    return run_step("hot-loop smoke",
+                    [sys.executable, os.path.join("scripts",
+                                                  "hot_loop_smoke.py")])
+
+
+def run_tests(extra) -> dict:
+    return run_step("tier-1 tests", [
+        sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
+        "--sanitize", "-p", "no:cacheprovider",
+        "--continue-on-collection-errors"] + list(extra))
+
+
+def write_summary(path: str, summary: dict) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(summary, f, indent=2)
+        f.write("\n")
 
 
 def main(argv=None) -> int:
@@ -92,29 +164,53 @@ def main(argv=None) -> int:
         split = argv.index("--")
         argv, passthrough = argv[:split], argv[split + 1:]
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--lint-only", action="store_true")
+    ap.add_argument("--lint-only", action="store_true",
+                    help="static analyzers only (lint + audit)")
     ap.add_argument("--tests-only", action="store_true")
+    ap.add_argument("--summary", default=None,
+                    help="where to write the machine-readable stage summary "
+                         "(repo-relative; default results/check_summary.json"
+                         " — single-stage runs skip the default write so a "
+                         "partial record never clobbers the full-gate one)")
     args = ap.parse_args(argv)
 
     single_stage = args.lint_only or args.tests_only
-    rc_lint = 0 if args.tests_only else run_lint()
-    # the smoke stages ride the full gate only: --lint-only / --tests-only
-    # keep their single-stage contract
-    rc_smoke = 0 if single_stage else run_telemetry_smoke()
-    rc_serve = 0 if single_stage else run_serving_smoke()
-    rc_hot = 0 if single_stage else run_hot_loop_smoke()
-    rc_tests = 0 if args.lint_only else run_tests(passthrough)
-
-    print("== check summary ".ljust(72, "="))
+    stages = []
     if not args.tests_only:
-        print(f"lint : {'ok' if rc_lint == 0 else f'FAILED (rc={rc_lint})'}")
+        stages.append(run_lint())
+        stages.append(run_audit())
     if not single_stage:
-        print(f"smoke: {'ok' if rc_smoke == 0 else f'FAILED (rc={rc_smoke})'}")
-        print(f"serve: {'ok' if rc_serve == 0 else f'FAILED (rc={rc_serve})'}")
-        print(f"hot  : {'ok' if rc_hot == 0 else f'FAILED (rc={rc_hot})'}")
+        stages.append(run_telemetry_smoke())
+        stages.append(run_serving_smoke())
+        stages.append(run_hot_loop_smoke())
     if not args.lint_only:
-        print(f"tests: {'ok' if rc_tests == 0 else f'FAILED (rc={rc_tests})'}")
-    return 1 if (rc_lint or rc_smoke or rc_serve or rc_hot or rc_tests) else 0
+        stages.append(run_tests(passthrough))
+
+    # gate on STATUS, not raw rc: an analyzer that exited 0 but produced
+    # unparseable output is recorded internal-error and must fail the gate
+    # (rc alone would wave it through)
+    summary = {"ok": all(s["status"] == "ok" for s in stages),
+               "stages": stages}
+    summary_path = args.summary
+    if summary_path is None and single_stage:
+        # the committed default summary records the FULL gate; a partial
+        # --lint-only/--tests-only record posing as it would claim stages
+        # that never ran
+        print("(single-stage run: default summary not written; pass "
+              "--summary <path> to record it)")
+    else:
+        summary_path = summary_path or os.path.join("results",
+                                                    "check_summary.json")
+        write_summary(os.path.join(REPO, summary_path), summary)
+        print(f"summary -> {summary_path}")
+    print("== check summary ".ljust(72, "="))
+    for s in stages:
+        note = "ok" if s["status"] == "ok" else \
+            f"{s['status'].upper()} (rc={s['rc']})"
+        extra = f", {s['findings']} finding(s)" \
+            if s.get("findings") else ""
+        print(f"{s['name']:<16}: {note}  [{s['wall_seconds']:.1f}s{extra}]")
+    return 0 if summary["ok"] else 1
 
 
 if __name__ == "__main__":

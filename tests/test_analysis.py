@@ -17,6 +17,7 @@ import pytest
 
 from iwae_replication_project_tpu.analysis import (
     BARE_SUPPRESSION,
+    USELESS_SUPPRESSION,
     LintConfig,
     all_rules,
     lint_paths,
@@ -449,6 +450,75 @@ class TestSuppression:
             "# second consumer, same key",
             "# iwaelint: disable=jit-in-loop -- wrong rule on purpose")
         assert "key-reuse" in rules_of(lint_src(tmp_path, src))
+
+
+# ---------------------------------------------------------------------------
+# useless-suppression (meta-rule keeping the suppression inventory honest)
+# ---------------------------------------------------------------------------
+
+class TestUselessSuppression:
+    def test_fires_when_the_rule_does_not_fire(self, tmp_path):
+        src = """
+            import jax
+
+            def fine(key, shape):
+                return jax.random.normal(key, shape)  # iwaelint: disable=key-reuse -- leftover from a refactor
+        """
+        assert rules_of(lint_src(tmp_path, src)) == [USELESS_SUPPRESSION]
+
+    def test_silent_when_the_suppression_is_live(self, tmp_path):
+        src = BAD_KEY_TWO_CONSUMERS.replace(
+            "# second consumer, same key",
+            "# iwaelint: disable=key-reuse -- antithetic pair by design")
+        assert lint_src(tmp_path, src) == []
+
+    def test_mixed_tokens_flag_only_the_dead_one(self, tmp_path):
+        src = BAD_KEY_TWO_CONSUMERS.replace(
+            "# second consumer, same key",
+            "# iwaelint: disable=key-reuse,jit-in-loop -- key pair is "
+            "antithetic")
+        (f,) = lint_src(tmp_path, src)
+        assert f.rule == USELESS_SUPPRESSION
+        assert "jit-in-loop" in f.message
+
+    def test_stale_file_scope_suppression_fires(self, tmp_path):
+        src = ("# iwaelint: disable-file=fragile-import -- nothing fragile "
+               "left here\nx = 1\n")
+        got = lint_src(tmp_path, src)
+        assert rules_of(got) == [USELESS_SUPPRESSION]
+        assert "file" in got[0].message
+
+    def test_not_judged_for_unselected_rules(self, tmp_path):
+        # a --select subset must not condemn the other rules' suppressions
+        src = """
+            import jax
+
+            def fine(key, shape):
+                return jax.random.normal(key, shape)  # iwaelint: disable=key-reuse -- judged only when key-reuse runs
+        """
+        assert lint_src(tmp_path, src, select=["jit-in-loop"]) == []
+
+    def test_unknown_rule_token_fires_even_under_select(self, tmp_path):
+        # a misspelled/removed rule name can never become live, so it is
+        # reported unconditionally — no run subset can vindicate it
+        src = """
+            import jax
+
+            def fine(key, shape):
+                return jax.random.normal(key, shape)  # iwaelint: disable=key-resue -- typo'd rule name
+        """
+        got = lint_src(tmp_path, src, select=["jit-in-loop"])
+        assert rules_of(got) == [USELESS_SUPPRESSION]
+        assert "unknown rule 'key-resue'" in got[0].message
+
+    def test_useless_suppression_is_not_suppressible(self, tmp_path):
+        src = """
+            import jax
+
+            def fine(key, shape):
+                return jax.random.normal(key, shape)  # iwaelint: disable=key-reuse,useless-suppression -- trying to silence the meta-rule
+        """
+        assert USELESS_SUPPRESSION in rules_of(lint_src(tmp_path, src))
 
 
 # ---------------------------------------------------------------------------

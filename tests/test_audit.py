@@ -1,0 +1,568 @@
+"""Fixture tests for the jaxpr-level program auditor (analysis/audit/).
+
+Per ISSUE 7's acceptance bar, every pass is proven LIVE by a fixture program
+seeding its hazard — a donation bug, an unmasked-padding reduction, a
+hot-path host transfer, a cache-fragmenting signature — plus a lock-order
+inversion for the concurrency checker; each hazard's discharged twin is
+proven clean; the real program suite audits clean end-to-end (the same
+contract scripts/check.py gates on); and the golden jaxpr signatures pin
+the serving programs and the train step against silent program drift.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+
+from iwae_replication_project_tpu.analysis import LintConfig, lint_paths
+from iwae_replication_project_tpu.analysis.audit import (
+    BARE_WAIVER,
+    AuditEnv,
+    AuditProgram,
+    all_passes,
+    build_programs,
+    run_audit,
+    select_passes,
+    signature,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN_PATH = os.path.join(REPO, "tests", "golden", "jaxpr_signatures.json")
+
+#: a fake env that isolates jaxpr-level checks from this host's backend and
+#: cache configuration (no registry -> no cross-test registry bleed)
+ENV_TPU = AuditEnv(backend="tpu", cache_dir="/tmp/cache")
+ENV_CPU_CACHE = AuditEnv(backend="cpu", cache_dir="/tmp/cache")
+
+
+def prog(name, fn, *args, taints=None, sig_args=None, hot=True, waivers=None):
+    return AuditProgram(name=name, jaxpr=jax.make_jaxpr(fn)(*args),
+                        taints=taints or {}, sig_args=sig_args, hot=hot,
+                        waivers=waivers or {})
+
+
+def audit(p, pass_name, env=ENV_TPU):
+    return run_audit([p], select_passes([pass_name]), env)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# framework
+# ---------------------------------------------------------------------------
+
+class TestFramework:
+    def test_four_passes_registered(self):
+        assert set(all_passes()) >= {"donation-safety", "padding-taint",
+                                     "host-transfer", "recompile-cardinality"}
+
+    def test_unknown_pass_raises(self):
+        with pytest.raises(ValueError, match="unknown pass"):
+            select_passes(["no-such-pass"])
+
+    def test_unknown_program_raises(self):
+        with pytest.raises(ValueError, match="unknown program"):
+            build_programs(["no-such-program"])
+
+    def test_waiver_silences_with_justification(self):
+        x = jnp.zeros((8, 4))
+        p = prog("waived", lambda x: jnp.sum(jnp.pad(x, ((0, 3), (0, 0)))),
+                 x, waivers={"padding-taint": "zero padding under plain sum "
+                                              "adds exact zeros"})
+        assert audit(p, "padding-taint") == []
+
+    def test_bare_waiver_is_its_own_finding(self):
+        x = jnp.zeros((8, 4))
+        p = prog("bare", lambda x: jnp.sum(jnp.pad(x, ((0, 3), (0, 0)))),
+                 x, waivers={"padding-taint": ""})
+        got = rules_of(audit(p, "padding-taint"))
+        assert BARE_WAIVER in got and "padding-taint" in got
+
+
+# ---------------------------------------------------------------------------
+# pass 1: donation-safety
+# ---------------------------------------------------------------------------
+
+class TestDonationSafety:
+    def test_fires_on_donated_but_unconsumed_input(self):
+        f = jax.jit(lambda a, b: b * 2.0, donate_argnums=(0,))
+        p = prog("don_unused", f, jnp.zeros((3,)), jnp.zeros((3,)))
+        got = audit(p, "donation-safety")
+        assert rules_of(got) == ["donation-safety"]
+        assert "never consumed" in got[0].message
+
+    def test_clean_when_every_donated_input_is_consumed(self):
+        f = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+        p = prog("don_used", f, jnp.zeros((3,)), jnp.zeros((3,)))
+        assert audit(p, "donation-safety") == []
+
+    def test_fires_on_donation_with_cpu_persistent_cache(self):
+        # the RESULTS.md §5 hazard class, statically: donation + warm cache
+        # on the CPU backend corrupts cache-deserialized executables
+        f = jax.jit(lambda a, b: a + b, donate_argnums=(0,))
+        p = prog("don_cache", f, jnp.zeros((3,)), jnp.zeros((3,)))
+        got = audit(p, "donation-safety", env=ENV_CPU_CACHE)
+        assert rules_of(got) == ["donation-safety"]
+        assert "donation_safe" in got[0].message
+
+    def test_clean_without_donation_even_on_cpu_cache(self):
+        p = prog("no_don", jax.jit(lambda a: a * 2), jnp.zeros((3,)))
+        assert audit(p, "donation-safety", env=ENV_CPU_CACHE) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 2: padding-taint
+# ---------------------------------------------------------------------------
+
+class TestPaddingTaint:
+    X = jnp.zeros((8, 4))
+
+    def test_fires_on_unmasked_logsumexp_over_padded_rows(self):
+        # THE IWAE hazard: exp(0)=1 from a padded row silently biases the
+        # k-sample bound (no NaN, no crash — just a wrong number)
+        p = prog("bad_lse",
+                 lambda x: jnp.mean(jax.scipy.special.logsumexp(x, axis=0)),
+                 self.X, taints={0: {0: 5}})
+        got = audit(p, "padding-taint")
+        assert got and all(f.rule == "padding-taint" for f in got)
+
+    def test_clean_when_iota_mask_discharges_the_taint(self):
+        def masked(x):
+            keep = lax.broadcasted_iota(jnp.int32, (8, 1), 0) < 5
+            return jnp.mean(jax.scipy.special.logsumexp(
+                jnp.where(keep, x, -jnp.inf), axis=0))
+        p = prog("good_lse", masked, self.X, taints={0: {0: 5}})
+        assert audit(p, "padding-taint") == []
+
+    def test_pad_eqn_seeds_taint_without_declaration(self):
+        # kernel-style internal padding needs no input declaration
+        p = prog("pad_reduce", lambda x: jnp.sum(
+            jax.scipy.special.logsumexp(jnp.pad(x, ((0, 3), (0, 0))),
+                                        axis=0)), self.X)
+        assert rules_of(audit(p, "padding-taint")) != []
+
+    def test_slice_off_the_padding_discharges(self):
+        # the pad -> compute -> out[:k] unpad idiom must prove clean
+        p = prog("pad_slice", lambda x: jnp.sum(
+            (jnp.pad(x, ((0, 3), (0, 0))) * 2)[:8], axis=0), self.X)
+        assert audit(p, "padding-taint") == []
+
+    def test_fires_on_contraction_over_padded_axis(self):
+        p = prog("dot_contract", lambda x: x.T @ x, self.X,
+                 taints={0: {0: 5}})
+        got = audit(p, "padding-taint")
+        assert got and "dot_general" in got[0].location
+
+    def test_inverted_iota_mask_does_not_discharge(self):
+        # polarity matters: this mask hands the PADDED rows the data
+        # operand, so it must not be blessed like the correct idiom
+        def inverted(x):
+            drop = lax.broadcasted_iota(jnp.int32, (8, 1), 0) >= 5
+            return jnp.mean(jax.scipy.special.logsumexp(
+                jnp.where(drop, x, -jnp.inf), axis=0))
+        p = prog("bad_mask", inverted, self.X, taints={0: {0: 5}})
+        assert rules_of(audit(p, "padding-taint")) != []
+
+    def test_uncompared_iota_does_not_discharge(self):
+        # a raw iota that never went through a comparison proves nothing
+        def bogus(x):
+            raw = lax.broadcasted_iota(jnp.int32, (8, 1), 0).astype(bool)
+            return jnp.sum(jnp.where(raw, x, 0.0), axis=0)
+        p = prog("raw_iota", bogus, self.X, taints={0: {0: 5}})
+        assert rules_of(audit(p, "padding-taint")) != []
+
+    def test_wrong_boundary_literal_mask_does_not_discharge(self):
+        # correctly polarized, wrong bound: iota < padded_size keeps every
+        # padded row, so it must not be blessed like iota < real_extent
+        def overwide(x):
+            keep = lax.broadcasted_iota(jnp.int32, (8, 1), 0) < 8
+            return jnp.mean(jax.scipy.special.logsumexp(
+                jnp.where(keep, x, -jnp.inf), axis=0))
+        p = prog("wide_mask", overwide, self.X, taints={0: {0: 5}})
+        assert rules_of(audit(p, "padding-taint")) != []
+
+    def test_traced_mask_bound_discharges_on_trust(self):
+        # a traced bound cannot be compared statically: discharged (the
+        # runtime parity pins' jurisdiction) and counted as unverified
+        def masked(x, n):
+            keep = lax.broadcasted_iota(jnp.int32, (8, 1), 0) < n
+            return jnp.mean(jax.scipy.special.logsumexp(
+                jnp.where(keep, x, -jnp.inf), axis=0))
+        p = prog("traced_mask", masked, self.X, jnp.int32(5),
+                 taints={0: {0: 5}})
+        assert audit(p, "padding-taint") == []
+
+    def test_reverse_cumsum_poisons_the_real_rows(self):
+        # reverse cumulation folds the padded tail into every real row, so
+        # the out[:real] unpad slice must NOT discharge afterwards
+        p = prog("rev_cum", lambda x: jnp.sum(
+            lax.cumsum(x, axis=0, reverse=True)[:5]), self.X,
+            taints={0: {0: 5}})
+        assert rules_of(audit(p, "padding-taint")) != []
+
+    def test_forward_cumsum_keeps_the_unpad_discharge(self):
+        # forward cumulation corrupts only the padded tail itself
+        p = prog("fwd_cum", lambda x: jnp.sum(
+            lax.cumsum(x, axis=0)[:5]), self.X, taints={0: {0: 5}})
+        assert audit(p, "padding-taint") == []
+
+    def test_reduction_along_clean_axis_stays_clean(self):
+        # row-taint must ride along reductions over OTHER axes (the serving
+        # programs' whole design: reduce over k/pixels, never over rows)
+        p = prog("other_axis", lambda x: jnp.sum(x * 2.0, axis=1), self.X,
+                 taints={0: {0: 5}})
+        assert audit(p, "padding-taint") == []
+
+
+# ---------------------------------------------------------------------------
+# pass 3: host-transfer
+# ---------------------------------------------------------------------------
+
+class TestHostTransfer:
+    @staticmethod
+    def _with_print(x):
+        jax.debug.print("loss {}", jnp.mean(x))
+        return x * 2
+
+    def test_fires_on_callback_in_hot_program(self):
+        p = prog("cb", self._with_print, jnp.zeros((3,)))
+        got = audit(p, "host-transfer")
+        assert rules_of(got) == ["host-transfer"]
+
+    def test_cold_programs_are_exempt(self):
+        p = prog("cb_cold", self._with_print, jnp.zeros((3,)), hot=False)
+        assert audit(p, "host-transfer") == []
+
+    def test_clean_pure_program(self):
+        p = prog("pure", lambda x: jnp.tanh(x).sum(), jnp.zeros((3,)))
+        assert audit(p, "host-transfer") == []
+
+
+# ---------------------------------------------------------------------------
+# pass 4: recompile-cardinality
+# ---------------------------------------------------------------------------
+
+class TestRecompileCardinality:
+    def test_fires_on_python_scalar_in_signature(self):
+        p = prog("scalar_sig", lambda x: x * 2, jnp.zeros((3,)),
+                 sig_args=((jnp.zeros((3,)), 0.75), {}))
+        got = audit(p, "recompile-cardinality")
+        assert rules_of(got) == ["recompile-cardinality"]
+        assert "PER VALUE" in got[0].message
+
+    def test_fires_on_weak_typed_program_input(self):
+        sds = jax.ShapeDtypeStruct((3,), jnp.float32, weak_type=True)
+        p = AuditProgram(name="weak_in",
+                         jaxpr=jax.make_jaxpr(lambda x: x * 2)(sds))
+        got = audit(p, "recompile-cardinality")
+        assert got and "weak-typed" in got[0].message
+
+    def test_clean_on_committed_arrays(self):
+        x = jnp.zeros((3,), jnp.float32)
+        p = prog("clean_sig", lambda x: x * 2, x, sig_args=((x,), {}))
+        assert audit(p, "recompile-cardinality") == []
+
+    def test_registry_entries_are_audited(self):
+        from iwae_replication_project_tpu.utils.compile_cache import (
+            aot_call, isolated_aot_registry, registry_signatures)
+        with isolated_aot_registry():
+            # a python float rides the dispatch args -> one executable per
+            # value: exactly the fragmentation the pass must flag
+            aot_call("frag_prog", jax.jit(lambda x, s: x * s),
+                     (jnp.zeros((2,)), 0.5))
+            env = AuditEnv(backend="tpu", cache_dir=None,
+                           registry=registry_signatures())
+            p = prog("any", lambda x: x, jnp.zeros((1,)))
+            got = run_audit([p], select_passes(["recompile-cardinality"]),
+                            env)
+        assert [f.program for f in got] == ["aot:frag_prog"]
+
+    def test_registry_findings_run_once_and_ignore_program_waivers(self):
+        # registry auditing is cross-program state: N audited programs must
+        # not multiply the findings, and one program's justified waiver must
+        # not silence a registry-wide fragmentation hazard
+        from iwae_replication_project_tpu.utils.compile_cache import (
+            aot_call, isolated_aot_registry, registry_signatures)
+        with isolated_aot_registry():
+            aot_call("frag_prog", jax.jit(lambda x, s: x * s),
+                     (jnp.zeros((2,)), 0.5))
+            env = AuditEnv(backend="tpu", cache_dir=None,
+                           registry=registry_signatures())
+            p1 = prog("waived", lambda x: x, jnp.zeros((1,)),
+                      waivers={"recompile-cardinality": "fixture program"})
+            p2 = prog("plain", lambda x: x, jnp.zeros((1,)))
+            got = run_audit([p1, p2],
+                            select_passes(["recompile-cardinality"]), env)
+        assert [f.program for f in got] == ["aot:frag_prog"]
+
+
+# ---------------------------------------------------------------------------
+# the concurrency checker (lint rules; ISSUE 7's fifth fixture class)
+# ---------------------------------------------------------------------------
+
+BAD_LOCK_ORDER = """
+    import threading
+
+    class Pipeline:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self.items = []
+
+        def produce(self):
+            with self._a:
+                with self._b:
+                    self.items.append(1)
+
+        def consume(self):
+            with self._b:
+                with self._a:
+                    return self.items.pop()
+"""
+
+BAD_INDIRECT_ORDER = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+
+        def outer(self):
+            with self._a:
+                self.inner()
+
+        def inner(self):
+            with self._b:
+                pass
+
+        def reverse(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+CLEAN_CONDITION_ALIAS = """
+    import threading
+
+    class E:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cv = threading.Condition(self._lock)
+
+        def f(self):
+            with self._cv:
+                with self._lock:
+                    pass
+"""
+
+BAD_THREE_CYCLE = """
+    import threading
+
+    class Trio:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._c = threading.Lock()
+
+        def ab(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def bc(self):
+            with self._b:
+                with self._c:
+                    pass
+
+        def ca(self):
+            with self._c:
+                with self._a:
+                    pass
+"""
+
+BAD_UNLOCKED_STATE = """
+    import threading
+
+    class Window:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self._open = 0
+
+        def acquire(self):
+            with self._cv:
+                self._open += 1
+
+        def force(self):
+            self._open += 1
+"""
+
+
+class TestConcurrencyRules:
+    def lint(self, tmp_path, src, rel="conc/m.py"):
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+        cfg = LintConfig(root=str(tmp_path), concurrency_paths=["conc"])
+        return lint_paths([str(path)], cfg, root=str(tmp_path))
+
+    def test_fires_on_lock_order_inversion(self, tmp_path):
+        assert rules_of(self.lint(tmp_path, BAD_LOCK_ORDER)) == ["lock-order"]
+
+    def test_fires_on_indirect_inversion_via_method_call(self, tmp_path):
+        assert "lock-order" in rules_of(self.lint(tmp_path,
+                                                  BAD_INDIRECT_ORDER))
+
+    def test_fires_on_three_lock_cycle(self, tmp_path):
+        # no pair inverts directly; the deadlock is the a->b->c->a cycle,
+        # which pairwise inversion checks cannot see
+        got = self.lint(tmp_path, BAD_THREE_CYCLE)
+        assert rules_of(got) == ["lock-order"] * 3
+        assert "cyclic lock order" in got[0].message
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        src = BAD_LOCK_ORDER.replace(
+            "with self._b:\n                with self._a:",
+            "with self._a:\n                with self._b:")
+        assert self.lint(tmp_path, src) == []
+
+    def test_condition_aliasing_is_not_an_inversion(self, tmp_path):
+        assert self.lint(tmp_path, CLEAN_CONDITION_ALIAS) == []
+
+    def test_fires_on_bare_write_of_guarded_attr(self, tmp_path):
+        got = self.lint(tmp_path, BAD_UNLOCKED_STATE)
+        assert rules_of(got) == ["unlocked-shared-state"]
+        assert "force" in got[0].message
+
+    def test_outside_concurrency_paths_is_silent(self, tmp_path):
+        assert self.lint(tmp_path, BAD_LOCK_ORDER, rel="other/m.py") == []
+
+    def test_real_concurrency_files_are_clean(self):
+        # the production thread triangle passes its own checker
+        cfg = LintConfig(root=REPO, select=["lock-order",
+                                            "unlocked-shared-state"])
+        files = [os.path.join(REPO, p) for p in (
+            "iwae_replication_project_tpu/serving/engine.py",
+            "iwae_replication_project_tpu/serving/batcher.py",
+            "iwae_replication_project_tpu/telemetry/registry.py")]
+        assert lint_paths(files, cfg, root=REPO) == []
+
+
+# ---------------------------------------------------------------------------
+# the real program suite + golden signatures
+# ---------------------------------------------------------------------------
+
+class TestRealProgramSuite:
+    def test_tree_audits_clean(self):
+        """THE acceptance gate: every pass over every real program, on this
+        host's actual backend/cache env (scripts/check.py stage 2)."""
+        programs = build_programs()
+        findings = run_audit(programs, all_passes(),
+                             AuditEnv.current(include_registry=False))
+        assert findings == [], "\n".join(f.human() for f in findings)
+
+    def test_serving_programs_declare_their_padding(self):
+        by_name = {p.name: p for p in build_programs(
+            ["serve_score", "serve_encode", "serve_decode"])}
+        for p in by_name.values():
+            assert len(p.taints) == 2, \
+                f"{p.name} lost its padded-row taint declaration"
+
+    def test_golden_jaxpr_signatures(self):
+        """Program-drift tripwire: eqn count + primitive histogram of the
+        three serving programs and the train step. An intended change
+        regenerates with IWAE_UPDATE_GOLDENS=1 (and shows up in the diff
+        instead of as mystery serving recompiles)."""
+        with open(GOLDEN_PATH, encoding="utf-8") as f:
+            golden = json.load(f)
+        progs = build_programs(sorted(golden))
+        current = {p.name: signature(p.jaxpr) for p in progs}
+        if os.environ.get("IWAE_UPDATE_GOLDENS"):
+            with open(GOLDEN_PATH, "w", encoding="utf-8") as f:
+                json.dump(current, f, indent=2, sort_keys=True)
+                f.write("\n")
+            pytest.skip("goldens regenerated")
+        assert current == golden, (
+            "traced program structure drifted from tests/golden/"
+            "jaxpr_signatures.json — if intended, regenerate with "
+            "IWAE_UPDATE_GOLDENS=1 pytest tests/test_audit.py")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m",
+             "iwae_replication_project_tpu.analysis.audit", *args],
+            cwd=REPO, capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+    def test_list_passes(self):
+        r = self._run("--list-passes")
+        assert r.returncode == 0
+        for name in ("donation-safety", "padding-taint", "host-transfer",
+                     "recompile-cardinality"):
+            assert name in r.stdout
+
+    def test_unknown_select_exits_2(self):
+        r = self._run("--select", "nope")
+        assert r.returncode == 2
+        assert "error" in r.stderr
+
+    def test_self_audit_clean_json(self):
+        """The CI invocation: full suite, JSON output, exit 0."""
+        r = self._run("--format", "json")
+        assert r.returncode == 0, r.stdout + r.stderr
+        payload = json.loads(r.stdout)
+        assert payload["total"] == 0
+        assert set(payload["programs"]) == {
+            "train_step", "eval_scorer_k5000", "serve_score", "serve_encode",
+            "serve_decode", "hot_loop_reference", "hot_loop_blocked_scan",
+            "hot_loop_pallas"}
+
+
+# ---------------------------------------------------------------------------
+# scripts/check.py integration
+# ---------------------------------------------------------------------------
+
+class TestCheckSummary:
+    def test_analyzer_rc_classification(self):
+        """The satellite bugfix: exit 2 (analyzer crash) must be
+        distinguishable from exit 1 (findings) — any nonzero-as-findings
+        conflation can mask analyzer crashes."""
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        try:
+            import check
+        finally:
+            sys.path.pop(0)
+        assert check.classify_analyzer_rc(0) == "ok"
+        assert check.classify_analyzer_rc(1) == "findings"
+        assert check.classify_analyzer_rc(2) == "internal-error"
+        assert check.classify_analyzer_rc(139) == "internal-error"
+
+    @pytest.mark.slow
+    def test_lint_only_writes_summary(self, tmp_path):
+        out = tmp_path / "summary.json"
+        r = subprocess.run(
+            [sys.executable, os.path.join("scripts", "check.py"),
+             "--lint-only", "--summary", str(out)],
+            cwd=REPO, capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stdout + r.stderr
+        payload = json.loads(out.read_text())
+        assert payload["ok"] is True
+        assert [s["name"] for s in payload["stages"]] == ["lint", "audit"]
+        for s in payload["stages"]:
+            assert s["status"] == "ok" and s["findings"] == 0
+            assert s["wall_seconds"] > 0
