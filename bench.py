@@ -323,9 +323,358 @@ SERVING_PIPE_ROWS = 420        # rows per serial/pipelined rep
 SERVING_PIPE_K = 10
 SERVING_PIPE_MAX_BATCH = 4
 SERVING_PIPE_REPS = 12         # paired closed-loop reps per dispatch mode
+REPLICA_COUNTS = (1, 2)        # fleet sizes for the replica_scaling sweep
+REPLICA_ROWS = 480             # rows per closed-loop rep
+REPLICA_REPS = 10              # timed reps per fleet size (paired medians:
+                               # this shared box's hypervisor steals whole
+                               # cores for stretches and per-pair ratios
+                               # spread ~0.9-1.5x, so the median needs a
+                               # deep sample; all walls are committed)
+REPLICA_BUCKET = 32            # one pinned bucket: every dispatch is the
+                               # same padded shape on every replica
+REPLICA_K = 150                # the scaling op point: an eval-grade score
+                               # budget (3x the training k; the repo's NLL
+                               # evals go to k=5000) so per-row device time
+                               # dominates the parent's JSON/TCP work — at
+                               # k=50 the sweep measures the wire, not the
+                               # fleet
+REPLICA_MAX_WAIT_US = 20000    # child coalescing window: splitting one
+                               # arrival stream N ways halves each child's
+                               # fill rate, and the engine default (2 ms)
+                               # then flushes half-empty buckets whose
+                               # padding burns the second core's win — 20 ms
+                               # lets every steady-state dispatch fill
 SERVING_PIPE_INFLIGHT = 10     # deeper than the serving default (2): small
 #                                CPU executions overlap, so a deeper window
 #                                keeps every core fed during fetch stalls
+
+
+def _bench_replica_scaling(cfg, state):
+    """The ``replica_scaling`` block: closed-loop throughput of the network
+    tier (serving/frontend/) at 1 and 2 replicas.
+
+    Each replica is ONE single-replica child tier in its OWN process with
+    single-threaded XLA compute and its own core pin (``iwae-serve
+    --replicas 1 --pin-core i`` under ``--xla_cpu_multi_thread_eigen=
+    false``) — the CPU bench box's stand-in for one accelerator per
+    replica: one core's worth of disjoint compute, a private XLA runtime,
+    and a private AOT cache, talking JSON-lines over TCP. The parent composes them with a :class:`ReplicaRouter` over
+    :class:`RemoteEngine` proxies — exactly the fleet shape the frontend
+    ships — and measures:
+
+    * **throughput per fleet size** — rows/sec over REPLICA_REPS closed
+      loops of REPLICA_ROWS single-row score requests (best-of, like the
+      pipeline comparison; all walls committed);
+    * **the box's own parallel ceiling** — the same workload through two
+      DIRECT pinned engines (no tier, no router, no sockets) run solo and
+      then concurrently, probe rounds interleaved with the fleet reps so
+      both see the same machine windows. A CPU "core" is not a device:
+      this box's two schedulable cores share FPU ports and memory
+      bandwidth, so two truly-single-threaded f32 engine processes reach
+      only ~1.2-1.3x aggregate (measured by this probe, committed as
+      ``box_ceiling_2proc``) — that ceiling, not the tier, bounds what ANY
+      2-process fleet can show here. The honest fleet metric on such a box
+      is ``scaling_efficiency_vs_box_ceiling`` = fleet speedup / ceiling;
+      the ``>= 1.5x at 2 replicas`` target is asserted against hardware
+      whose replicas have disjoint compute (one device — or one real core
+      — each), which the probe verifies rather than assumes;
+    * **front-end cost at 1 replica** — the 1-replica tier against the
+      bare engine in the same windows (``tier_1replica_over_direct_
+      engine``): how much of the parent's routing + JSON/TCP work hides
+      behind the replica's compute vs lands as a throughput tax. The
+      parent is a third process on this 2-core box, competing for the
+      same shared capacity — another reason the 2-replica gain here is
+      bounded by the measured leftover, not by the tier;
+    * **bitwise parity** — the untimed first round's results (parent seeds
+      0..N-1 in admission order) against the direct probe engine's first
+      pass over the same rows in the same order (identically configured
+      process: XLA:CPU partitions reductions by pool size, so the
+      reference must share the replicas' single-threaded compute config):
+      routing, processes, and the wire must be bitwise invisible;
+    * **zero recompiles** — every child's over-the-wire ``stats`` must show
+      0 ``aot_misses`` / 0 ``recompiles`` across the whole post-warmup
+      stream.
+
+    Children run on JAX_PLATFORMS=cpu by design: the sweep measures the
+    TIER (routing + wire + admission overhead and how it scales), with
+    pinned cores modeling per-replica devices; a per-chip TPU fleet round
+    reuses this harness with one process per accelerator.
+    """
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    from iwae_replication_project_tpu.serving.frontend import (
+        RemoteEngine, ReplicaRouter, TierClient)
+    from iwae_replication_project_tpu.utils.checkpoint import save_checkpoint
+    from iwae_replication_project_tpu.utils.config import ExperimentConfig
+
+    cores = sorted(os.sched_getaffinity(0))
+    counts = [n for n in REPLICA_COUNTS if n <= len(cores)]
+    if len(counts) < len(REPLICA_COUNTS):
+        return {"skipped": f"needs >= {max(REPLICA_COUNTS)} cores to pin "
+                           f"one replica process per core; box has "
+                           f"{len(cores)}"}
+
+    # children serve THIS bench's weights from a throwaway checkpoint (the
+    # default ExperimentConfig IS the flagship 2L the bench builds;
+    # compute_dtype pinned to f32 to match the parent's direct engine —
+    # the stored default is the TPU bf16 knob, and a dtype mismatch would
+    # break the bitwise-parity contract, not just weaken it)
+    tmp = tempfile.mkdtemp(prefix="iwae_replica_bench_")
+    run_dir = os.path.join(tmp, "run")
+    save_checkpoint(run_dir, 0, state, stage=1,
+                    config_json=ExperimentConfig(
+                        compute_dtype=None).to_json())
+
+    rng = np.random.RandomState(11)
+    stream = (rng.rand(REPLICA_ROWS, 784) > 0.5).astype(np.float32)
+
+    # every replica-model process (children AND the parity reference) runs
+    # single-threaded XLA compute + its own core pin: one replica = one
+    # core's worth of compute, enforced two ways because each covers the
+    # other's blind spot — the eigen flag stops the intra-op pool from
+    # spanning cores (and from SPINNING: N replicas x multi-thread pools
+    # oversubscribe the box into anti-scaling, measured 0.85x; sandboxed
+    # kernels like this CI box's also simply ignore sched_setaffinity),
+    # the pin gives placement isolation where the kernel honors it. The
+    # reference shares the config because XLA partitions reductions by
+    # pool size — a differently-threaded engine is bitwise-different
+    # float32, and the parity contract is against the engine the fleet
+    # actually models.
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_cpu_multi_thread_eigen=false").strip()
+
+    # direct probe engines: the parity reference AND the box-ceiling
+    # calibration in one process per core — warm up, score the stream once
+    # (the parity payload: engine-minted seeds 0..N-1 in submit order, the
+    # exact semantics the tier must reproduce), then serve timed scoring
+    # rounds on demand (one line in = one timed pass, wall out), so solo
+    # and duo rounds can be interleaved with the fleet reps against LIVE
+    # processes without re-paying startup
+    probe_code = (
+        "import json, os, sys, time\n"
+        "os.sched_setaffinity(0, {int(sys.argv[1])})\n"
+        "import numpy as np\n"
+        "from iwae_replication_project_tpu.serving import ServingEngine\n"
+        "from iwae_replication_project_tpu.serving.buckets import "
+        "BucketLadder\n"
+        "req = json.loads(sys.stdin.readline())\n"
+        "eng = ServingEngine(req['run_dir'], k=req['k'],\n"
+        "                    ladder=BucketLadder((req['bucket'],)),\n"
+        "                    max_batch=req['bucket'], max_inflight=0,\n"
+        "                    timeout_s=None)\n"
+        "eng.warmup(ops=('score',))\n"
+        "x = np.asarray(req['x'], np.float32)\n"
+        "out = eng.score(x)\n"
+        "print(json.dumps([float(v) for v in out]), flush=True)\n"
+        "for line in sys.stdin:\n"
+        "    if not line.strip():\n"
+        "        continue\n"
+        "    t0 = time.perf_counter()\n"
+        "    eng.score(x)\n"
+        "    print(json.dumps({'wall': time.perf_counter() - t0}),\n"
+        "          flush=True)\n")
+
+    spawned = []       # every live subprocess, for the failure-path sweep
+
+    def spawn_probe(core):
+        p = subprocess.Popen(
+            [_sys.executable, "-c", probe_code, str(core)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        spawned.append(p)
+        p.stdin.write(json.dumps({
+            "run_dir": run_dir, "k": REPLICA_K, "bucket": REPLICA_BUCKET,
+            "x": stream.tolist()}) + "\n")
+        p.stdin.flush()
+        first = np.asarray(json.loads(p.stdout.readline()),
+                           dtype=np.float32)
+        return p, first
+
+    def probe_round(probes):
+        """One timed scoring pass on each probe, started together."""
+        for p, _ in probes:
+            p.stdin.write("go\n")
+            p.stdin.flush()
+        return [json.loads(p.stdout.readline())["wall"] for p, _ in probes]
+
+    def spawn(core):
+        p = subprocess.Popen(
+            [_sys.executable, "-m", "iwae_replication_project_tpu.serving",
+             "--replicas", "1", "--port", "0", "--checkpoint", run_dir,
+             "--k", str(REPLICA_K), "--buckets", str(REPLICA_BUCKET),
+             "--max-batch", str(REPLICA_BUCKET),
+             "--max-wait-us", str(REPLICA_MAX_WAIT_US), "--timeout-s", "0",
+             # one execution at a time per replica: the in-flight pipeline
+             # would run 2 concurrent single-threaded executions on the
+             # PJRT pool — a 2-core replica in disguise, breaking the
+             # one-core-per-device model this sweep scales over
+             "--max-inflight", "0",
+             "--ops", "score", "--pin-core", str(core)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        spawned.append(p)
+        ready = json.loads(p.stdout.readline())
+        return p, ready["tier"]["port"]
+
+    # both fleet sizes run over the SAME live children, reps interleaved
+    # back-to-back in alternating order — this shared box's effective CPU
+    # speed swings by tens of percent between windows, so an unpaired
+    # best-of-N ratio mostly measures which fleet drew the quieter windows;
+    # pairing cancels the common mode (the pipeline_comparison treatment).
+    # Fleet "1" routes to child A only (child B idles, blocked on its
+    # socket — zero CPU); fleet "2" routes over A and B via its own
+    # connections.
+    try:
+        probe_a = spawn_probe(cores[0])
+        probe_b = spawn_probe(cores[1])
+        ref = probe_a[1]             # the parity reference results
+        procs = [spawn(cores[i]) for i in range(max(counts))]
+        fleets = {n: ReplicaRouter([RemoteEngine("127.0.0.1", port)
+                                    for _, port in procs[:n]])
+                  for n in counts}
+
+        def closed_loop(router):
+            futures = [router.submit("score", row) for row in stream]
+            for f in futures:
+                f.result()
+            return futures
+
+        # untimed warm round per fleet: parent seeds 0..N-1 — the parity
+        # round (and it pre-touches the JSON/TCP path on every replica)
+        parity = {}
+        for n, router in fleets.items():
+            got = np.asarray([f.result() for f in closed_loop(router)],
+                             dtype=ref.dtype)
+            parity[n] = bool(np.array_equal(got, ref))
+
+        walls = {n: [] for n in counts}
+        solo_walls, duo_walls = [], []
+        for rep in range(REPLICA_REPS):
+            order = list(counts) if rep % 2 else list(counts)[::-1]
+            for n in order:
+                t0 = time.perf_counter()
+                closed_loop(fleets[n])
+                walls[n].append(time.perf_counter() - t0)
+            # the box-ceiling probe rides the same machine window as this
+            # rep's fleet pair: one solo pass (probe A alone = the direct
+            # single-replica workload) then one duo pass (A and B started
+            # together = two disjoint "devices", if the box can express it)
+            solo_walls.append(probe_round([probe_a])[0])
+            duo_walls.append(max(probe_round([probe_a, probe_b])))
+
+        # the zero-recompile proof, read over the wire from each child
+        child_stats = []
+        for _, port in procs:
+            with TierClient("127.0.0.1", port) as cli:
+                eng_c = cli.stats()["engines"][0]
+            child_stats.append({k: int(eng_c.get(k, 0)) for k in
+                                ("dispatches", "completed", "aot_hits",
+                                 "aot_misses", "recompiles")})
+        for router in fleets.values():
+            router.drain(timeout_s=60)
+        for p, _ in procs:
+            p.stdin.close()          # lifetime control: stdin EOF = stop
+            p.wait(timeout=60)
+        for p, _ in (probe_a, probe_b):
+            p.stdin.close()
+            p.wait(timeout=60)
+    finally:
+        # failure sweep (no-op on success: everything above already
+        # exited): a crashed sweep must not leave pinned child/probe
+        # processes alive to skew every later bench stage, nor the
+        # throwaway checkpoint dir behind
+        for p in spawned:
+            try:
+                if p.stdin and not p.stdin.closed:
+                    p.stdin.close()
+            except OSError:
+                pass
+            if p.poll() is None:
+                p.kill()
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    levels = [{
+        "replicas": n,
+        "rows_per_rep": REPLICA_ROWS,
+        "rows_per_sec": round(REPLICA_ROWS / min(walls[n]), 2),
+        "wall_seconds": [round(w, 4) for w in walls[n]],
+        "bitwise_identical_to_direct_engine": parity[n],
+    } for n in counts]
+    def median(xs):
+        xs = sorted(xs)
+        mid = len(xs) // 2
+        return xs[mid] if len(xs) % 2 else (xs[mid - 1] + xs[mid]) / 2
+
+    # per-pair speedups (adjacent in time; the robust ratio estimator) —
+    # the headline is their median, best-of throughputs sit alongside
+    pairs = sorted(w1 / w2 for w1, w2 in zip(walls[counts[0]],
+                                             walls[counts[-1]]))
+    median_pair = median(pairs)
+    # the box ceiling: aggregate throughput of two DIRECT pinned engines
+    # over one, same paired treatment (2 * solo wall / duo wall per rep)
+    ceiling_pairs = [2 * s / d for s, d in zip(solo_walls, duo_walls)]
+    ceiling = median(ceiling_pairs)
+    # the 1-replica tier vs the bare engine, same windows: how much of
+    # the parent's routing + JSON/TCP work hides behind replica compute
+    # (>= 1: fully overlapped) vs lands as a throughput tax (< 1)
+    overlap_pairs = [s / w for s, w in zip(solo_walls, walls[counts[0]])]
+    target = 1.5
+    misses = sum(c["aot_misses"] for c in child_stats)
+    recompiles = sum(c["recompiles"] for c in child_stats)
+    return {
+        "method": "one single-core child tier process per replica "
+                  "(iwae-serve --replicas 1 --pin-core i, single-threaded "
+                  "XLA compute via --xla_cpu_multi_thread_eigen=false), "
+                  "parent ReplicaRouter over RemoteEngine proxies, "
+                  "JSON-lines/TCP; parity reference + box-ceiling probe "
+                  "are direct engines in identically configured processes "
+                  "(XLA:CPU partitions reductions by pool size), probe "
+                  "rounds interleaved with the fleet reps",
+        "k": REPLICA_K, "bucket": REPLICA_BUCKET,
+        "levels": levels,
+        "per_child": child_stats,
+        # median of per-pair (1-replica wall / 2-replica wall) ratios over
+        # back-to-back alternating reps: machine-speed swings hit both
+        # fleet sizes of a pair equally, so the pair ratio is the honest
+        # scaling estimator on this box (all walls committed above)
+        "speedup_2_over_1": round(median_pair, 3),
+        "speedup_2_over_1_pairs": [round(r, 3) for r in pairs],
+        # what 2 disjoint single-threaded engine processes — no tier at
+        # all — deliver over 1 on THIS box: the physical bound on any
+        # 2-replica result here (two schedulable cores sharing FPU ports
+        # and memory bandwidth are not two devices)
+        "box_ceiling_2proc": round(ceiling, 3),
+        "box_ceiling_2proc_pairs": [round(r, 3) for r in ceiling_pairs],
+        "box_probe_solo_walls": [round(w, 4) for w in solo_walls],
+        "box_probe_duo_walls": [round(w, 4) for w in duo_walls],
+        # 1-replica tier / bare direct engine, paired per rep: the front
+        # end's net cost — 1.0 means routing + wire + admission fully
+        # hide behind the replica's compute
+        "tier_1replica_over_direct_engine": round(median(overlap_pairs), 3),
+        "tier_1replica_over_direct_engine_pairs": [
+            round(r, 3) for r in overlap_pairs],
+        "target_speedup_2_replicas": target,
+        "target_met": bool(median_pair >= target),
+        "target_expressible_on_this_box": bool(ceiling >= target),
+        # how much of the box's measured parallel capacity the tier
+        # actually delivers — the number that transfers to real fleets
+        # (one device per replica), where the ceiling is ~N
+        "scaling_efficiency_vs_box_ceiling": round(median_pair / ceiling, 3),
+        "bitwise_identical_to_direct_engine": all(parity.values()),
+        "post_warmup_aot_misses": misses,
+        "post_warmup_recompiles": recompiles,
+    }
 
 
 def bench_serving():
@@ -349,7 +698,12 @@ def bench_serving():
       dispatcher blocks on every fetch) vs through the two-stage pipeline
       (async enqueue + completion thread, bounded in-flight window): the
       throughput ratio is the dispatch-overlap payoff, and the per-request
-      results must be bitwise identical across modes.
+      results must be bitwise identical across modes;
+    * **replica scaling** — the network tier (serving/frontend/) at 1 and 2
+      replica processes (one pinned core each): closed-loop throughput per
+      fleet size, bitwise parity against a direct single engine, and the
+      over-the-wire zero-recompile proof (see
+      :func:`_bench_replica_scaling`).
 
     Prints one JSON line and writes results/serving_bench.json.
     """
@@ -522,6 +876,9 @@ def bench_serving():
         "post_warmup_recompiles": int(spd["persistent_cache_misses"]),
     }
 
+    # -- the fleet step: replica scaling through the network tier -----------
+    replica_scaling = _bench_replica_scaling(cfg, state)
+
     out = {
         "metric": "online serving: dynamic micro-batching over AOT warm "
                   "paths (IWAE-k50-2L score)",
@@ -536,6 +893,7 @@ def bench_serving():
         "warmup": warm_info,
         "load_sweep": levels,
         "pipeline_comparison": pipe_cmp,
+        "replica_scaling": replica_scaling,
         # serving-phase roofline: closed-loop MFU + which hot-loop path the
         # warmed score programs traced with (ops/hot_loop.PATH_CODES)
         "mfu": serving_mfu,

@@ -37,6 +37,7 @@ def _default_hot_paths() -> List[str]:
     return ["iwae_replication_project_tpu/training",
             "iwae_replication_project_tpu/parallel",
             "iwae_replication_project_tpu/ops",
+            "iwae_replication_project_tpu/serving/frontend",
             "iwae_replication_project_tpu/analysis/audit"]
 
 
@@ -48,7 +49,7 @@ def _default_entry_points() -> List[str]:
             "iwae_replication_project_tpu/serving/cli.py",
             "iwae_replication_project_tpu/analysis/audit/cli.py", "bench.py",
             "scripts/dress_rehearsal.py", "scripts/warm_start_check.py",
-            "__graft_entry__.py"]
+            "scripts/serving_tier_smoke.py", "__graft_entry__.py"]
 
 
 def _default_cache_owners() -> List[str]:
@@ -67,6 +68,7 @@ def _default_concurrency_paths() -> List[str]:
     # completion, metric scrapes) and the registry they all report through
     return ["iwae_replication_project_tpu/serving/engine.py",
             "iwae_replication_project_tpu/serving/batcher.py",
+            "iwae_replication_project_tpu/serving/frontend",
             "iwae_replication_project_tpu/telemetry/registry.py"]
 
 

@@ -3,8 +3,12 @@
 ``ServingEngine`` turns ragged online requests (``encode`` / ``decode`` /
 ``score``) into fixed-shape bucket dispatches through the compile-once AOT
 executable registry. See engine.py for the request lifecycle and
-ARCHITECTURE.md "Serving" for the subsystem map. CLI:
-``python -m iwae_replication_project_tpu.serving`` (or ``iwae-serve``).
+ARCHITECTURE.md "Serving" for the subsystem map. The network-facing layer
+— N engine replicas behind a TCP front end with routing, quotas, and
+failure handling — lives in :mod:`.frontend` (``ServingTier`` /
+``TierClient``). CLI: ``python -m iwae_replication_project_tpu.serving``
+(or ``iwae-serve``; ``--replicas/--port`` runs the tier, ``--client``
+drives one over TCP).
 """
 
 from iwae_replication_project_tpu.serving.batcher import (

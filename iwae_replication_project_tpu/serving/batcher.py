@@ -43,6 +43,24 @@ class RequestTimeout(RuntimeError):
     """The request's deadline passed while it waited in the queue."""
 
 
+def complete_future(fut: Future, result=None, exc=None) -> bool:
+    """Complete a future, tolerating caller-side cancellation and duplicate
+    completions: a client that cancelled its pending Future — or a reroute
+    that already delivered it — must not be able to kill the completing
+    thread with InvalidStateError (dispatcher, completion, router-callback,
+    and remote-reader threads all outlive any one request by contract).
+    Returns whether this call delivered the result. The ONE shared
+    implementation for the engine, the replica router, and RemoteEngine."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+        return True
+    except Exception:   # cancelled (or already completed): drop quietly
+        return False
+
+
 @dataclasses.dataclass
 class Request:
     """One row of work: a single example plus its program selector.

@@ -1,6 +1,19 @@
 """``iwae-serve``: warm the bucket ladder, then serve.
 
-Two modes after warmup:
+One binary, three roles:
+
+* **in-process engine** (default, no ``--replicas``/``--client``): the
+  original single-engine modes below;
+* **serving tier** (``--replicas N [--port P]``): N engine replicas over
+  shared weights behind the TCP front end (serving/frontend/) — prints a
+  ready line with the bound port, serves until stdin EOF (or SIGINT),
+  then drains gracefully and prints the final router snapshot;
+* **tier client** (``--client HOST:PORT``): drive a running tier over TCP
+  — synthetic ragged load by default (same ``--requests``/``--sizes``
+  knobs, payload dims discovered via the ``info`` op), or
+  ``--interactive`` to forward JSON-lines requests from stdin.
+
+In-process modes after warmup:
 
 * **synthetic load** (default): a Poisson-ish open-loop request stream of
   ragged batch sizes against the engine — the smoke/load profile, printing
@@ -58,8 +71,51 @@ def build_argparser() -> argparse.ArgumentParser:
                          "batches for the two-stage pipeline (dispatcher "
                          "enqueues async, a completion thread fetches); "
                          "0 = serial dispatch (the pre-pipeline baseline)")
-    ap.add_argument("--timeout-s", dest="timeout_s", type=float, default=2.0)
+    ap.add_argument("--timeout-s", dest="timeout_s", type=float, default=2.0,
+                    help="per-request queue deadline; <= 0 disables (what "
+                         "deep closed-loop benches want)")
+    ap.add_argument("--buckets", type=str, default=None,
+                    help="comma-separated explicit bucket ladder (e.g. "
+                         "'32' pins every dispatch to ONE padded shape, "
+                         "making results bitwise independent of batch "
+                         "composition — the fleet-parity configuration); "
+                         "default: powers of two up to --max-batch")
+    ap.add_argument("--pin-core", dest="pin_core", type=int, default=None,
+                    help="pin this process to one CPU core before JAX "
+                         "initializes (XLA sizes its intra-op pool from the "
+                         "schedulable-CPU count, so a pinned replica "
+                         "process models one device: disjoint compute, no "
+                         "cross-replica contention)")
     ap.add_argument("--seed", type=int, default=0)
+    tier = ap.add_argument_group("serving tier (serving/frontend/)")
+    tier.add_argument("--replicas", type=int, default=0,
+                      help="run the network tier with N engine replicas "
+                           "over shared weights (0 = in-process engine "
+                           "modes, the default)")
+    tier.add_argument("--port", type=int, default=0,
+                      help="tier TCP port (0 = ephemeral, printed in the "
+                           "ready line)")
+    tier.add_argument("--host", type=str, default="127.0.0.1")
+    tier.add_argument("--max-outstanding", dest="max_outstanding", type=int,
+                      default=4096,
+                      help="tier-wide admission ceiling (outstanding "
+                           "requests) before typed 'overloaded' rejections")
+    tier.add_argument("--quota-rate", dest="quota_rate", type=float,
+                      default=None,
+                      help="per-client token-bucket refill (rows/sec); "
+                           "omit = quotas off")
+    tier.add_argument("--quota-burst", dest="quota_burst", type=float,
+                      default=None,
+                      help="per-client bucket capacity in rows (default "
+                           "10x rate when --quota-rate is set)")
+    tier.add_argument("--client", type=str, default=None, metavar="HOST:PORT",
+                      help="client mode: drive a running tier over TCP "
+                           "(synthetic load, or --interactive to forward "
+                           "stdin JSON lines)")
+    tier.add_argument("--client-id", dest="client_id", type=str,
+                      default=None,
+                      help="client mode: the quota principal stamped on "
+                           "requests")
     ap.add_argument("--interactive", action="store_true",
                     help="serve JSON-lines requests from stdin instead of "
                          "synthetic load")
@@ -83,25 +139,170 @@ def build_argparser() -> argparse.ArgumentParser:
     return ap
 
 
+def _engine_knobs(args) -> dict:
+    """The ServingEngine keyword set shared by every construction path."""
+    from iwae_replication_project_tpu.serving.buckets import BucketLadder
+
+    ladder = None
+    if args.buckets:
+        ladder = BucketLadder(tuple(
+            int(s) for s in args.buckets.split(",") if s))
+    return dict(
+        max_batch=args.max_batch, max_wait_us=args.max_wait_us,
+        queue_limit=args.queue_limit, max_inflight=args.max_inflight,
+        timeout_s=(args.timeout_s if args.timeout_s > 0 else None),
+        ladder=ladder, seed=args.seed)
+
+
 def _build_engine(args):
     from iwae_replication_project_tpu.serving.engine import ServingEngine
 
     if args.checkpoint:
-        eng = ServingEngine(args.checkpoint, k=args.k,
-                            max_batch=args.max_batch,
-                            max_wait_us=args.max_wait_us,
-                            queue_limit=args.queue_limit,
-                            max_inflight=args.max_inflight,
-                            timeout_s=args.timeout_s, seed=args.seed)
-        return eng
+        return ServingEngine(args.checkpoint, k=args.k,
+                             **_engine_knobs(args))
     from iwae_replication_project_tpu import zoo
     from iwae_replication_project_tpu.utils.config import ExperimentConfig
     ecfg = zoo.get(args.preset) if args.preset else ExperimentConfig()
-    return zoo.serving_engine(
-        ecfg, k=args.k, max_batch=args.max_batch,
-        max_wait_us=args.max_wait_us, queue_limit=args.queue_limit,
-        max_inflight=args.max_inflight,
-        timeout_s=args.timeout_s, seed=args.seed)
+    return zoo.serving_engine(ecfg, k=args.k, **_engine_knobs(args))
+
+
+def _build_replicas(args, n: int):
+    """N engines over ONE set of weights (replica fleet construction):
+    the first engine resolves the checkpoint/preset, the rest share its
+    params and config — process-local replicas, exactly what the tier
+    composes on a multi-device host with one engine per device."""
+    from iwae_replication_project_tpu.serving.engine import ServingEngine
+
+    first = _build_engine(args)
+    engines = [first]
+    for _ in range(1, n):
+        engines.append(ServingEngine(
+            params=first._params, model_config=first.cfg, k=first.k,
+            **_engine_knobs(args)))
+    return engines
+
+
+def _tier_mode(args, ops) -> int:
+    """``--replicas N``: run the network tier until stdin EOF/SIGINT."""
+    from iwae_replication_project_tpu.serving.frontend import (
+        QuotaPolicy, ServingTier)
+
+    quota = None
+    if args.quota_rate is not None:
+        quota = QuotaPolicy(rate=args.quota_rate,
+                            burst=(args.quota_burst
+                                   if args.quota_burst is not None
+                                   else 10.0 * args.quota_rate))
+    tier = ServingTier(_build_replicas(args, args.replicas), quota=quota,
+                       max_outstanding=args.max_outstanding,
+                       host=args.host, port=args.port)
+    warm = tier.warmup(ops=ops)
+    tier.start()
+    metrics_srv = None
+    if args.metrics_port is not None:
+        from iwae_replication_project_tpu.telemetry import (
+            get_registry, start_metrics_server)
+        # process spans + the router's gauges/counters; per-replica engine
+        # histograms stay in the shutdown snapshot (their unprefixed names
+        # would collide across replicas on one exposition page)
+        metrics_srv = start_metrics_server(
+            (get_registry(), tier.registry), args.metrics_port)
+    print(json.dumps({
+        "tier": {"replicas": args.replicas, "port": tier.port,
+                 "host": args.host,
+                 "quota": tier.info()["quota"]},
+        "warmup": warm,
+        "buckets": tier.info()["buckets"], "k": tier.info()["k"],
+        "metrics_port": (metrics_srv.server_address[1]
+                         if metrics_srv else None)}), flush=True)
+    try:
+        for _ in sys.stdin:     # lifetime control: serve until stdin EOF
+            pass
+    except KeyboardInterrupt:
+        pass
+    tier.stop()
+    if metrics_srv is not None:
+        metrics_srv.shutdown()
+    snap = tier.registry.snapshot()
+    print(json.dumps({
+        "router": {k: v for k, v in snap["counters"].items()
+                   if k.startswith("router/")},
+        "replicas": tier.router.replica_states(),
+        "engines": [e.metrics.snapshot()["counters"]
+                    for e in tier.router.engines]}), flush=True)
+    return 0
+
+
+def _client_interactive(cli) -> None:
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+            rid = cli.submit(req.get("op", "score"), req["x"],
+                             k=req.get("k"), seed=req.get("seed"))
+            resp = cli.drain([rid])[rid]
+            # the caller correlates on ITS id, not the client's wire id
+            resp["id"] = req.get("id")
+            print(json.dumps(resp), flush=True)
+        except Exception as e:  # a bad request must not kill the loop
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+
+def _client_mode(args) -> int:
+    """``--client HOST:PORT``: drive a running tier over TCP."""
+    import numpy as np
+
+    from iwae_replication_project_tpu.serving.frontend import TierClient
+
+    host, _, port = args.client.rpartition(":")
+    cli = TierClient(host or "127.0.0.1", int(port),
+                     client_id=args.client_id)
+    if args.interactive:
+        _client_interactive(cli)
+        cli.close()
+        return 0
+    info = cli.info()
+    ops = [s for s in args.ops.split(",") if s and s in info["row_dims"]]
+    if not ops:
+        print(json.dumps({"error": f"none of the requested ops "
+                                   f"({args.ops}) is served by this tier; "
+                                   f"it serves {sorted(info['row_dims'])}"}),
+              file=sys.stderr, flush=True)
+        cli.close()
+        return 2
+    sizes = [int(s) for s in args.sizes.split(",") if s]
+    rng = np.random.RandomState(args.seed)
+    dims = info["row_dims"]
+    ids = []
+    t0 = time.perf_counter()
+    for i in range(args.requests):
+        op = ops[i % len(ops)]
+        n = sizes[i % len(sizes)]
+        batch = (rng.rand(n, dims[op]) > 0.5).astype(np.float32) \
+            if op != "decode" else rng.randn(n, dims[op]).astype(np.float32)
+        ids.append((cli.submit(op, batch.tolist()), n))
+        if args.rate > 0:
+            time.sleep(rng.exponential(1.0 / args.rate))
+    responses = cli.drain([rid for rid, _ in ids])
+    wall = time.perf_counter() - t0
+    cli.close()
+    ok_rows = sum(n for rid, n in ids if responses[rid].get("ok"))
+    errors: dict = {}
+    for rid, _ in ids:
+        r = responses[rid]
+        if not r.get("ok"):
+            errors[r.get("error", "internal")] = \
+                errors.get(r.get("error", "internal"), 0) + 1
+    out = {"mode": "client", "target": args.client,
+           "requests": args.requests, "ok_rows": ok_rows,
+           "errors": errors, "wall_seconds": round(wall, 3),
+           "rows_per_sec": round(ok_rows / wall, 2) if wall else None,
+           "info": info}
+    print(json.dumps(out), flush=True)
+    return 0
 
 
 def _synthetic_load(eng, ops, args) -> dict:
@@ -166,12 +367,27 @@ def _interactive(eng, args) -> None:
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
 
+    if args.client:
+        # pure socket client: no model, no device, no cache to set up
+        return _client_mode(args)
+
+    if args.pin_core is not None:
+        # before ANY jax import: XLA:CPU sizes its intra-op thread pool
+        # from the schedulable-CPU count at backend init, so pinning here
+        # gives this replica process a disjoint single-core compute slice
+        # (the replica_scaling bench runs one pinned process per "device")
+        os.sched_setaffinity(0, {args.pin_core})
+
     from iwae_replication_project_tpu.utils.compile_cache import (
         setup_persistent_cache)
 
     # warm path: compiled serving programs persist across server restarts —
     # keyed under the checkpoint dir when serving one, else the cwd
     setup_persistent_cache(base_dir=args.checkpoint or os.getcwd())
+
+    if args.replicas > 0:
+        return _tier_mode(args,
+                          tuple(s for s in args.ops.split(",") if s))
 
     eng = _build_engine(args)
     ops = tuple(s for s in args.ops.split(",") if s)

@@ -56,6 +56,7 @@ from iwae_replication_project_tpu.serving.batcher import (
     MicroBatcher,
     Request,
     RequestTimeout,
+    complete_future,
 )
 from iwae_replication_project_tpu.serving.buckets import (
     BucketLadder,
@@ -167,9 +168,19 @@ class ServingEngine:
     # request API
     # ------------------------------------------------------------------
 
-    def submit(self, op: str, row, k: Optional[int] = None) -> Future:
+    def submit(self, op: str, row, k: Optional[int] = None, *,
+               seed: Optional[int] = None) -> Future:
         """Enqueue ONE example; returns its Future. Raises
         :class:`EngineOverloaded` when the queue bound is hit.
+
+        ``seed`` overrides the engine's own per-request seed counter: a
+        request's result is a pure function of (weights, payload, seed, k)
+        (serving/programs.py), so a caller that mints its own seeds — the
+        replica router (serving/frontend/router.py) mints them in tier
+        admission order — gets results that are bitwise independent of
+        WHICH engine replica serves the request, and a retried request
+        re-submitted with its original seed returns the identical result.
+        The counter does not advance on an explicit-seed submit.
 
         The queue only drains when something pumps it: call :meth:`start`
         first for background dispatch (the serving deployment shape), or
@@ -183,9 +194,16 @@ class ServingEngine:
         k = (self.k if k is None else int(k)) if takes_k else 0
         row = as_row(row, self.row_dims[op], op)
         now = self._clock()
+        if seed is not None and not 0 <= int(seed) < 2 ** 31:
+            # the seed rides a row of the int32 seeds tensor: an
+            # out-of-range value would OverflowError at batch assembly and
+            # take the whole coalesced batch down with it — reject THIS
+            # request synchronously instead
+            raise ValueError(f"seed must be in [0, 2**31), got {seed}")
         with self._cv:
-            seed = self._seed_counter
-            self._seed_counter = (self._seed_counter + 1) % (2 ** 31)
+            if seed is None:
+                seed = self._seed_counter
+                self._seed_counter = (self._seed_counter + 1) % (2 ** 31)
             req = Request(op=op, payload=row, k=k, seed=seed, t_enqueue=now,
                           deadline=(now + self.timeout_s
                                     if self.timeout_s is not None else None))
@@ -332,20 +350,9 @@ class ServingEngine:
             self._finish(inf)
             self._window.done()
 
-    @staticmethod
-    def _complete(fut: Future, result=None, exc=None) -> bool:
-        """Complete a future, tolerating caller-side cancellation: a client
-        that cancelled its pending Future must not be able to kill the
-        dispatcher thread with InvalidStateError (the thread outlives any
-        one request by contract). Returns whether the result was delivered."""
-        try:
-            if exc is not None:
-                fut.set_exception(exc)
-            else:
-                fut.set_result(result)
-            return True
-        except Exception:  # cancelled (or already completed): drop quietly
-            return False
+    # tolerant completion (shared with the router and RemoteEngine): a
+    # cancelled or already-completed Future must never kill the thread
+    _complete = staticmethod(complete_future)
 
     def _complete_expired(self, expired: List[Request]) -> None:
         for r in expired:

@@ -1,0 +1,60 @@
+"""Network-facing serving tier: replica fleet, router, admission control.
+
+This package is the public boundary of the serving stack — the layer that
+turns N in-process :class:`~..engine.ServingEngine` replicas (one per
+device, or per mesh slice; process-local threads on CPU CI) into one
+network endpoint speaking JSON lines over TCP:
+
+    client ──TCP──► ServingTier (server.py)
+                      │  admission: global ceiling + per-client quotas
+                      ▼
+                    ReplicaRouter (router.py)
+                      │  least-inflight + (op, k) affinity, failure reroute
+                      ▼
+                    ServingEngine replicas (…serving/engine.py)
+
+Module map — one concern per file, every policy unit-testable with fakes:
+
+* ``protocol.py`` — the wire format (JSON lines, typed error codes) and
+  framing helpers; no sockets, no engines;
+* ``quotas.py`` — per-client token-bucket admission (the quota state
+  machine; injectable clock);
+* ``router.py`` — replica selection (least-inflight with (op, k) bucket
+  affinity), health/readiness (failure + stall detection, warm-probe
+  re-admission), reroute-with-same-seed retries, graceful drain;
+* ``server.py`` — the TCP front end: per-connection request loop,
+  admission control, response completion callbacks, shutdown drain;
+* ``client.py`` — the matching socket client (``iwae-serve --client``,
+  smoke scripts, benches);
+* ``remote.py`` — a running tier wrapped back into the engine surface
+  (``RemoteEngine``), so a parent router composes fleets out of processes
+  (the ``replica_scaling`` bench) and recursively out of fleets.
+
+Per-request semantics are unchanged from the single engine: requests are
+scored with k-sample IWAE log p̂(x) (arXiv:1509.00519), seeds are minted at
+tier admission in arrival order and carried through routing — so results
+are bitwise identical to a direct single-engine run no matter how the fleet
+routed, rerouted, or padded the work.
+"""
+
+from iwae_replication_project_tpu.serving.frontend.client import TierClient
+from iwae_replication_project_tpu.serving.frontend.protocol import (
+    ERROR_CODES,
+    error_code_for,
+)
+from iwae_replication_project_tpu.serving.frontend.quotas import (
+    ClientQuotas,
+    QuotaExceeded,
+    QuotaPolicy,
+)
+from iwae_replication_project_tpu.serving.frontend.remote import RemoteEngine
+from iwae_replication_project_tpu.serving.frontend.router import (
+    ReplicaRouter,
+    ReplicaUnavailable,
+    TierOverloaded,
+)
+from iwae_replication_project_tpu.serving.frontend.server import ServingTier
+
+__all__ = ["ServingTier", "ReplicaRouter", "TierClient", "RemoteEngine",
+           "ClientQuotas", "QuotaPolicy", "QuotaExceeded", "TierOverloaded",
+           "ReplicaUnavailable", "ERROR_CODES", "error_code_for"]

@@ -1,0 +1,156 @@
+"""Socket client for the serving tier (``iwae-serve --client``).
+
+One TCP connection speaking the JSON-lines protocol, with two calling
+shapes:
+
+* **blocking** — :meth:`TierClient.request` (and the ``score`` / ``encode``
+  / ``decode`` sugar) sends one request and waits for its response;
+* **pipelined** — :meth:`submit` writes a request and returns its id
+  immediately; :meth:`drain` reads until every outstanding id has its
+  response. The tier answers out of order (replicas finish when they
+  finish), so both shapes demultiplex on the echoed ``id``.
+
+Results come back as plain Python lists (the JSON payload, one entry per
+row) and errors as :class:`TierError` carrying the typed protocol code —
+the client performs no array conversion, so callers choose their own
+container (and this module stays clean under the serving host-sync lint,
+which covers serving/frontend/).
+
+The client is intentionally single-threaded: reads happen on the calling
+thread inside ``request``/``drain``. One client = one connection = one
+in-order request stream; run several clients for concurrency (the bench
+and smoke do).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Dict, List, Optional
+
+from iwae_replication_project_tpu.serving.frontend import protocol
+
+__all__ = ["TierClient", "TierError"]
+
+
+class TierError(RuntimeError):
+    """A typed error response from the tier (``code`` is one of
+    :data:`~.protocol.ERROR_CODES`)."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(f"[{code}] {message}")
+        self.code = code
+
+
+class TierClient:
+    """One JSON-lines connection to a :class:`~.server.ServingTier`."""
+
+    def __init__(self, host: str, port: int, *,
+                 client_id: Optional[str] = None,
+                 timeout_s: Optional[float] = 60.0):
+        self.client_id = client_id
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._reader = protocol.LineReader(self._sock)
+        self._next_id = 0
+        #: id -> response, for replies read while waiting on another id
+        self._responses: Dict[int, Dict[str, Any]] = {}
+
+    # -- pipelined API ------------------------------------------------------
+
+    def submit(self, op: str, x, k: Optional[int] = None,
+               seed: Optional[int] = None) -> int:
+        """Send one request without waiting; returns its wire id. ``seed``
+        (single-row payloads only) is the fleet-composition hook — see
+        protocol.py; ordinary callers leave it unset."""
+        self._next_id += 1
+        req_id = self._next_id
+        req: Dict[str, Any] = {"id": req_id, "op": op, "x": x}
+        if k is not None:
+            req["k"] = k
+        if seed is not None:
+            req["seed"] = seed
+        if self.client_id is not None:
+            req["client"] = self.client_id
+        self._sock.sendall(protocol.encode_line(req))
+        return req_id
+
+    def _read_one(self) -> Dict[str, Any]:
+        line = self._reader.next_line()
+        if line is None:
+            raise ConnectionError("tier closed the connection")
+        return protocol.decode_line(line)
+
+    def wait(self, req_id: int) -> List[Any]:
+        """Block until `req_id`'s response arrives (buffering others);
+        returns the per-row result list or raises :class:`TierError`."""
+        while req_id not in self._responses:
+            resp = self._read_one()
+            self._responses[resp.get("id")] = resp
+        resp = self._responses.pop(req_id)
+        if not resp.get("ok"):
+            raise TierError(resp.get("error", "internal"),
+                            resp.get("message", ""))
+        return resp["result"]
+
+    def drain(self, req_ids: List[int]) -> Dict[int, Dict[str, Any]]:
+        """Collect the raw response objects for every id (errors included
+        as objects, NOT raised — burst callers triage afterwards)."""
+        want = set(req_ids)
+        out: Dict[int, Dict[str, Any]] = {}
+        for rid in list(want):
+            if rid in self._responses:
+                out[rid] = self._responses.pop(rid)
+                want.discard(rid)
+        while want:
+            resp = self._read_one()
+            rid = resp.get("id")
+            if rid in want:
+                out[rid] = resp
+                want.discard(rid)
+            else:
+                self._responses[rid] = resp
+        return out
+
+    # -- blocking API -------------------------------------------------------
+
+    def request(self, op: str, x, k: Optional[int] = None) -> List[Any]:
+        return self.wait(self.submit(op, x, k=k))
+
+    def score(self, x, k: Optional[int] = None) -> List[Any]:
+        """Per-row k-sample IWAE log p̂(x) (list of floats)."""
+        return self.request("score", x, k=k)
+
+    def encode(self, x, k: Optional[int] = None) -> List[Any]:
+        return self.request("encode", x, k=k)
+
+    def decode(self, h) -> List[Any]:
+        return self.request("decode", h)
+
+    def _control(self, op: str) -> Dict[str, Any]:
+        self._next_id += 1
+        self._sock.sendall(protocol.encode_line(
+            {"id": self._next_id, "op": op}))
+        return self.wait(self._next_id)
+
+    def info(self) -> Dict[str, Any]:
+        """The tier's ``info`` control document (ops, row dims, buckets)."""
+        return self._control("info")
+
+    def stats(self) -> Dict[str, Any]:
+        """The tier's live ``stats`` document (router counters/gauges,
+        replica health, per-engine counters)."""
+        return self._control("stats")
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    def __enter__(self) -> "TierClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,160 @@
+"""The serving tier's wire format: JSON lines over TCP, typed errors.
+
+One request per ``\\n``-terminated UTF-8 line, one response line per
+request; responses may arrive **out of order** (the tier completes them as
+replicas finish), so callers match on the echoed ``id``.
+
+Request object::
+
+    {"op": "score" | "encode" | "decode",   # required
+     "x": [..row..] | [[..rows..]],          # required payload
+     "k": 50,                                # optional (score/encode only)
+     "id": <any JSON value>,                 # optional, echoed verbatim
+     "client": "tenant-a",                   # optional quota principal
+     "seed": 17}                             # optional, single-row only
+
+``seed`` is the fleet-composition hook: serving results are a pure function
+of (weights, payload, seed, k), so a PARENT router that mints its own seeds
+— :class:`~.remote.RemoteEngine` proxying this tier as one replica of a
+bigger fleet — gets results that are bitwise independent of which process
+served the request. It applies to single-row payloads only (one seed names
+one row's RNG stream; a multi-row request with ``seed`` is a
+``bad_request``) and ordinary clients never need it: the tier seeds
+requests itself, in admission order.
+
+``{"op": "info"}`` is answered directly by the front end (ops, per-op row
+dims, default k, bucket ladder, replica count) — clients use it to size
+payloads — and ``{"op": "stats"}`` likewise returns the live router
+counters/gauges plus each replica engine's counter snapshot (what the
+bench's zero-recompile proof and the smoke's failure accounting read over
+the wire). Control ops are never routed, quota'd, or counted against the
+ceiling.
+
+Response object::
+
+    {"id": ..., "ok": true,  "result": [..per-row results..]}
+    {"id": ..., "ok": false, "error": "<code>", "message": "..."}
+
+Error codes (``ERROR_CODES``) are the tier's failure model, one code per
+admission/serving outcome — a rejected request is a typed *response*, never
+a dropped connection:
+
+* ``bad_request``   — malformed JSON, unknown op, wrong payload shape;
+* ``overloaded``    — global ceiling hit or every replica's queue shed
+  (:class:`~..batcher.EngineOverloaded` /
+  :class:`~.router.TierOverloaded`): back off and retry;
+* ``quota_exceeded``— the client's token bucket ran dry
+  (:class:`~.quotas.QuotaExceeded`): retry after the refill interval;
+* ``timeout``       — the request expired in a replica queue
+  (:class:`~..batcher.RequestTimeout`);
+* ``unavailable``   — no healthy replica, or the tier is draining
+  (:class:`~.router.ReplicaUnavailable`);
+* ``internal``      — anything else (the replica raised; the request was
+  retried on other replicas first — see router.py).
+
+This module is pure data plumbing: no sockets, no engines, no numpy — so
+the protocol is testable byte-for-byte and both the server and the client
+share one implementation of framing and error taxonomy.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+#: the typed error taxonomy (see module docstring)
+ERROR_CODES = ("bad_request", "overloaded", "quota_exceeded", "timeout",
+               "unavailable", "internal")
+
+#: protocol ops the front end answers itself (never routed to a replica)
+CONTROL_OPS = ("info", "stats")
+
+#: max accepted request line (bytes) — a framing bound, not a row bound:
+#: 64 MiB comfortably fits a max_batch x 784-float payload and stops a
+#: malformed client from ballooning server memory with one endless line
+MAX_LINE_BYTES = 64 * 1024 * 1024
+
+
+class ProtocolError(ValueError):
+    """Malformed frame or request object (maps to ``bad_request``)."""
+
+
+def encode_line(obj: Dict[str, Any]) -> bytes:
+    """One protocol object as a framed wire line."""
+    return (json.dumps(obj, separators=(",", ":")) + "\n").encode("utf-8")
+
+
+def decode_line(line: bytes) -> Dict[str, Any]:
+    """Parse one framed line into a protocol object (dict)."""
+    try:
+        obj = json.loads(line.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError(f"malformed JSON line: {e}") from None
+    if not isinstance(obj, dict):
+        raise ProtocolError(
+            f"protocol objects are JSON objects, got {type(obj).__name__}")
+    return obj
+
+
+def ok_response(req_id: Any, result) -> Dict[str, Any]:
+    return {"id": req_id, "ok": True, "result": result}
+
+
+def error_response(req_id: Any, code: str, message: str) -> Dict[str, Any]:
+    if code not in ERROR_CODES:
+        code = "internal"
+    return {"id": req_id, "ok": False, "error": code, "message": message}
+
+
+def error_code_for(exc: BaseException) -> str:
+    """Map an exception from admission/routing/serving onto the typed code
+    the client sees. Import-local to keep this module dependency-light."""
+    from iwae_replication_project_tpu.serving.batcher import (
+        EngineOverloaded, RequestTimeout)
+    from iwae_replication_project_tpu.serving.frontend.quotas import (
+        QuotaExceeded)
+    from iwae_replication_project_tpu.serving.frontend.router import (
+        ReplicaUnavailable, TierOverloaded)
+
+    if isinstance(exc, QuotaExceeded):
+        return "quota_exceeded"
+    if isinstance(exc, (TierOverloaded, EngineOverloaded)):
+        return "overloaded"
+    if isinstance(exc, RequestTimeout):
+        return "timeout"
+    if isinstance(exc, ReplicaUnavailable):
+        return "unavailable"
+    if isinstance(exc, (ProtocolError, ValueError, KeyError, TypeError)):
+        return "bad_request"
+    return "internal"
+
+
+class LineReader:
+    """Buffered ``\\n``-framed reader over a socket-like object.
+
+    ``next_line()`` returns one complete line (without the terminator) or
+    None on clean EOF; a line exceeding MAX_LINE_BYTES or a mid-line EOF
+    raises :class:`ProtocolError`.
+    """
+
+    def __init__(self, sock, max_line_bytes: int = MAX_LINE_BYTES):
+        self._sock = sock
+        self._buf = bytearray()
+        self._max = max_line_bytes
+
+    def next_line(self) -> Optional[bytes]:
+        while True:
+            nl = self._buf.find(b"\n")
+            if nl >= 0:
+                line = bytes(self._buf[:nl])
+                del self._buf[:nl + 1]
+                return line
+            if len(self._buf) > self._max:
+                raise ProtocolError(
+                    f"request line exceeds {self._max} bytes")
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                if self._buf:
+                    raise ProtocolError("connection closed mid-line")
+                return None
+            self._buf.extend(chunk)

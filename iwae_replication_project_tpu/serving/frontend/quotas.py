@@ -1,0 +1,133 @@
+"""Per-client admission quotas: the token-bucket state machine.
+
+Each client id (the request's ``client`` field; absent = the shared
+``"anonymous"`` principal) owns one token bucket refilled continuously at
+``rate`` tokens/sec up to ``burst`` capacity; admitting a request costs one
+token per payload row. A dry bucket rejects with :class:`QuotaExceeded` —
+which the front end turns into a typed ``quota_exceeded`` response, NOT a
+dropped connection — and rejection never consumes tokens, so a throttled
+client recovers after exactly ``cost / rate`` seconds of restraint.
+
+The quota layer sits ABOVE the router on purpose: client identity is an
+admission-time concern only. Once admitted, a request carries no client
+field anywhere near the engines, so per-client state can never leak into an
+AOT program signature (pinned by tests/test_frontend.py's multi-client
+zero-recompile test).
+
+Pure data structure + one lock; the clock is injectable so every policy
+transition (refill, burst clamp, reject) is unit-testable with a fake
+clock, exactly like serving/batcher.py's MicroBatcher.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+#: the principal charged when a request carries no ``client`` field
+DEFAULT_CLIENT = "anonymous"
+
+
+class QuotaExceeded(RuntimeError):
+    """The client's token bucket cannot cover the request; retry later."""
+
+
+@dataclasses.dataclass(frozen=True)
+class QuotaPolicy:
+    """Token-bucket parameters shared by every client principal.
+
+    ``rate`` tokens/sec refill, ``burst`` bucket capacity (also the largest
+    single admissible request, in rows). A new client starts with a full
+    bucket — the first burst is free, sustained traffic pays ``rate``.
+    """
+
+    rate: float
+    burst: float
+
+    def __post_init__(self):
+        if self.rate <= 0 or self.burst <= 0:
+            raise ValueError(
+                f"quota rate and burst must be > 0, got {self}")
+
+
+class ClientQuotas:
+    """Thread-safe per-client token buckets under one policy.
+
+    ``policy=None`` disables quotas entirely (every admit succeeds and no
+    state is kept) — the default, so the tier without quota flags behaves
+    exactly like the bare engine stack.
+    """
+
+    def __init__(self, policy: Optional[QuotaPolicy] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self._policy = policy
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: client -> [tokens, last_refill_time]; guarded by _lock
+        self._buckets: Dict[str, List[float]] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self._policy is not None
+
+    def _refilled(self, client: str, now: float) -> List[float]:
+        """The client's bucket, refilled to `now` (caller holds _lock)."""
+        p = self._policy
+        b = self._buckets.get(client)
+        if b is None:
+            b = self._buckets.setdefault(client, [p.burst, now])
+        else:
+            b[0] = min(p.burst, b[0] + (now - b[1]) * p.rate)
+            b[1] = now
+        return b
+
+    def admit(self, client: Optional[str], cost: float) -> None:
+        """Charge `cost` tokens to `client` or raise :class:`QuotaExceeded`.
+
+        A rejected request consumes nothing. A cost above ``burst`` can
+        never be admitted and says so explicitly — the client must split
+        the request rather than retry forever.
+        """
+        if self._policy is None:
+            return
+        client = client or DEFAULT_CLIENT
+        if cost > self._policy.burst:
+            raise QuotaExceeded(
+                f"request cost {cost:g} rows exceeds the per-client burst "
+                f"capacity {self._policy.burst:g} — split the request")
+        with self._lock:
+            b = self._refilled(client, self._clock())
+            if b[0] < cost:
+                wait = (cost - b[0]) / self._policy.rate
+                raise QuotaExceeded(
+                    f"client {client!r} quota exhausted "
+                    f"({b[0]:.2f}/{self._policy.burst:g} tokens, cost "
+                    f"{cost:g}); retry in ~{wait:.2f}s")
+            b[0] -= cost
+
+    def refund(self, client: Optional[str], cost: float) -> None:
+        """Return `cost` tokens to `client` (clamped at burst): the undo
+        for an :meth:`admit` whose request the tier then failed to serve —
+        a typed routing rejection (ceiling, fleet-wide shed, draining)
+        must not burn the client's budget, or sustained overload would
+        stack ``quota_exceeded`` on top of ``overloaded`` and break the
+        documented cost/rate recovery accounting."""
+        if self._policy is None:
+            return
+        with self._lock:
+            b = self._refilled(client or DEFAULT_CLIENT, self._clock())
+            b[0] = min(self._policy.burst, b[0] + cost)
+
+    def tokens(self, client: Optional[str]) -> Optional[float]:
+        """Current refilled token balance (None when quotas are off) —
+        introspection for tests and the tier's snapshot."""
+        if self._policy is None:
+            return None
+        with self._lock:
+            return self._refilled(client or DEFAULT_CLIENT, self._clock())[0]
+
+    def clients(self) -> List[str]:
+        with self._lock:
+            return sorted(self._buckets)

@@ -1,0 +1,224 @@
+"""``RemoteEngine``: a serving tier as one replica of a bigger fleet.
+
+The proxy presents the engine surface the replica router dispatches over —
+``submit(op, row, k=, seed=)`` returning a Future, ``stop()``,
+``row_dims``, ``k`` — backed by ONE JSON-lines TCP connection to a running
+:class:`~.server.ServingTier`. A parent :class:`~.router.ReplicaRouter`
+over N RemoteEngines therefore composes fleets out of *processes* (each
+child tier owns its own device, CPU pin, and XLA runtime — the
+``bench.py --serving`` ``replica_scaling`` sweep builds exactly this), and
+recursively out of fleets: protocol.py's explicit ``seed`` field exists so
+the parent's admission-order seeds ride through to the leaf engines and
+results stay bitwise independent of which process served each request.
+
+Failure semantics map back onto the engine exception taxonomy the router
+already speaks:
+
+* a typed ``overloaded`` response completes the future with
+  :class:`~..batcher.EngineOverloaded` (the router tries peers without
+  declaring the replica dead — an async shed means *full*, not *failed*);
+* ``timeout`` becomes :class:`~..batcher.RequestTimeout` (per-request
+  outcome, no reroute);
+* a lost connection fails every outstanding future with
+  :class:`~.router.ReplicaUnavailable` and poisons the proxy — subsequent
+  submits raise synchronously, so the parent marks the replica unhealthy
+  and its warm probes drive reconnection attempts.
+
+Ops and payload dims are validated locally against the child tier's
+``info`` document (fetched at connect time), so malformed requests raise
+``ValueError`` synchronously like the in-process engine instead of
+surfacing as a ``bad_request`` future failure that would smear the replica.
+
+One lock guards the socket write side + the pending-future map; the reader
+thread completes futures strictly outside it (completion callbacks — the
+parent router's — re-enter :meth:`submit`).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, Optional
+
+from iwae_replication_project_tpu.serving.batcher import (
+    EngineOverloaded,
+    RequestTimeout,
+    complete_future as _complete,
+)
+from iwae_replication_project_tpu.serving.frontend import protocol
+from iwae_replication_project_tpu.serving.frontend.router import (
+    ReplicaUnavailable,
+)
+
+__all__ = ["RemoteEngine"]
+
+#: typed response code -> the engine exception the router dispatches on
+_CODE_EXC = {
+    "overloaded": EngineOverloaded,
+    "timeout": RequestTimeout,
+    "unavailable": ReplicaUnavailable,
+    "bad_request": ValueError,
+}
+
+
+class RemoteEngine:
+    """The engine surface over one TCP connection to a serving tier."""
+
+    def __init__(self, host: str, port: int, *,
+                 connect_timeout_s: float = 30.0):
+        self._addr = (host, port)
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._reader = protocol.LineReader(self._sock)
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        #: wire id -> Future for every in-flight request (guarded by _lock)
+        self._pending: Dict[int, Future] = {}
+        self._next_id = 0
+        self._dead: Optional[str] = None    # poison reason once connection dies
+        # the child tier's shape contract, fetched synchronously before the
+        # reader thread takes over the receive side
+        self._sock.sendall(protocol.encode_line({"id": 0, "op": "info"}))
+        line = self._reader.next_line()
+        if line is None:
+            raise ConnectionError(f"tier at {host}:{port} closed during "
+                                  "the info handshake")
+        info = protocol.decode_line(line)
+        if not info.get("ok"):
+            raise ConnectionError(
+                f"tier info handshake failed: {info.get('message')}")
+        doc = info["result"]
+        self.row_dims = {op: int(d) for op, d in doc["row_dims"].items()}
+        self.k = doc.get("k")
+        self.info = doc
+        self._sock.settimeout(None)     # the reader blocks; handshake timed
+        self._reader_thread = threading.Thread(
+            target=self._read_loop, name=f"iwae-remote-{host}:{port}",
+            daemon=True)
+        self._reader_thread.start()
+
+    # -- engine surface ------------------------------------------------------
+
+    def submit(self, op: str, row, k: Optional[int] = None, *,
+               seed: Optional[int] = None) -> Future:
+        """One row to the child tier; returns the proxy Future.
+
+        Validation (unknown op, wrong feature count, poisoned connection)
+        raises synchronously, exactly like the in-process engine — the
+        parent router's submit-failure path handles it.
+        """
+        if op not in self.row_dims:
+            raise ValueError(
+                f"unknown op {op!r}; this tier serves {sorted(self.row_dims)}")
+        row = row.tolist() if hasattr(row, "tolist") else list(row)
+        if len(row) != self.row_dims[op]:
+            raise ValueError(f"op {op!r} rows have {self.row_dims[op]} "
+                             f"features, got {len(row)}")
+        req: Dict[str, Any] = {"op": op, "x": row}
+        if k is not None:
+            req["k"] = int(k)
+        if seed is not None:
+            seed = int(seed)
+            if not 0 <= seed < 2 ** 31:
+                # the leaf engines' int32 seed-tensor bound, enforced at
+                # every boundary a seed can enter the fleet through
+                raise ValueError(f"seed must be in [0, 2**31), got {seed}")
+            req["seed"] = seed
+        fut: Future = Future()
+        with self._lock:
+            if self._dead is not None:
+                raise ReplicaUnavailable(
+                    f"remote tier {self._addr[0]}:{self._addr[1]} is gone "
+                    f"({self._dead})")
+            self._next_id += 1
+            req["id"] = self._next_id
+            self._pending[self._next_id] = fut
+            try:
+                self._sock.sendall(protocol.encode_line(req))
+            except OSError as e:
+                del self._pending[self._next_id]
+                self._dead = f"send failed: {e}"
+                raise ReplicaUnavailable(
+                    f"remote tier send failed: {e}") from None
+        return fut
+
+    def start(self) -> None:
+        """No-op: the child tier's engines are already running."""
+
+    def stop(self, timeout_s: float = 60.0) -> None:
+        """Drain the proxy: wait for every outstanding future (the child
+        tier is still serving them), then close the connection. The child
+        tier itself keeps running — its own lifecycle owner stops it."""
+        with self._idle:
+            self._idle.wait_for(lambda: not self._pending or self._dead,
+                                timeout=timeout_s)
+        self.close()
+
+    def warmup(self, ops=(), ks=None) -> Dict[str, float]:
+        """No-op: the child tier warmed its replicas before its ready line
+        (serving/cli.py `_tier_mode`); there is nothing to compile here."""
+        return {}
+
+    # -- receive side --------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                line = self._reader.next_line()
+            except (protocol.ProtocolError, OSError) as e:
+                self._fail_all(f"receive failed: {e}")
+                return
+            if line is None:
+                self._fail_all("tier closed the connection")
+                return
+            try:
+                resp = protocol.decode_line(line)
+            except protocol.ProtocolError as e:
+                self._fail_all(f"malformed response: {e}")
+                return
+            with self._lock:
+                fut = self._pending.pop(resp.get("id"), None)
+                self._idle.notify_all()
+            if fut is None:
+                continue        # duplicate/unknown id: first-wins upstream
+            # complete OUTSIDE the lock: the parent router's callback may
+            # re-enter submit()
+            if resp.get("ok"):
+                result = resp.get("result")
+                # one submit = one row; unwrap the per-row result list
+                _complete(fut, result=result[0]
+                          if isinstance(result, list) and len(result) == 1
+                          else result)
+            else:
+                exc_type = _CODE_EXC.get(resp.get("error", "internal"),
+                                         RuntimeError)
+                _complete(fut, exc=exc_type(resp.get("message", "")))
+
+    def _fail_all(self, reason: str) -> None:
+        with self._lock:
+            if self._dead is None:
+                self._dead = reason
+            orphans = list(self._pending.values())
+            self._pending.clear()
+            self._idle.notify_all()
+        for fut in orphans:
+            _complete(fut, exc=ReplicaUnavailable(
+                f"remote tier connection lost: {reason}"))
+
+    def close(self) -> None:
+        with self._lock:
+            if self._dead is None:
+                self._dead = "closed"
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    def __enter__(self) -> "RemoteEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

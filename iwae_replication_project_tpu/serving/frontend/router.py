@@ -1,0 +1,505 @@
+"""Replica routing: least-inflight dispatch, (op, k) affinity, health.
+
+The router owns the fleet-level request lifecycle between admission
+(server.py — quotas and parsing live there; the router never sees a client
+id) and the per-replica engines:
+
+* **seed minting** — every admitted request gets a tier-level seed in
+  arrival order (or keeps an explicitly supplied one). Serving results are
+  a pure function of (weights, payload, seed, k) — serving/programs.py —
+  so routing, re-routing, and replica choice are all bitwise invisible:
+  the fleet returns exactly what one direct engine would (pinned by
+  tests/test_frontend.py and ``bench.py --serving``'s ``replica_scaling``
+  parity check);
+* **selection policy** — least-inflight over healthy replicas, tie-broken
+  by lowest replica index, with sticky (op, k)-group affinity: the replica
+  that last served a group keeps it while its inflight stays within
+  ``affinity_slack`` of the least-loaded candidate, so same-shape requests
+  keep flowing to the replica whose AOT/jit caches (and, on hardware, its
+  device-resident executables) are already warm for that bucket — load
+  imbalance beyond the slack overrides affinity;
+* **failure handling** — an engine that raises (at submit or via its
+  future) marks its replica unhealthy and its outstanding work is
+  re-dispatched to healthy peers *with the original seeds* (a reroute
+  returns the identical result). A replica whose oldest in-flight request
+  stalls past ``stall_deadline_s`` is drained the same way. Unhealthy
+  replicas are re-admitted after a successful warm probe (a real request
+  through the engine's warmed program that completes within
+  ``probe_timeout_s``). Duplicate completions from abandoned dispatches
+  are first-wins and error-ignored;
+* **admission ceiling** — at most ``max_outstanding`` requests live in the
+  tier at once; past it, :meth:`submit` raises :class:`TierOverloaded`
+  (the typed ``overloaded`` response upstream). An individual replica's
+  :class:`~..batcher.EngineOverloaded` shed makes the router try its
+  peers first; only when EVERY healthy replica sheds does the caller see
+  the overload;
+* **graceful drain** — :meth:`drain` stops intake, flushes every replica
+  via ``engine.stop()``, waits for the outstanding count to reach zero,
+  and error-completes any leftover future with
+  :class:`ReplicaUnavailable`: every accepted request gets a result or a
+  typed error, never silence.
+
+Observability: one :class:`~...telemetry.registry.MetricRegistry` per
+router — ``router/inflight/r<i>`` and ``router/healthy/r<i>`` gauges per
+replica plus routed/reroutes/sheds/replica_failures/affinity_hits/
+stall_drains/probe_readmits counters — exported on the tier's Prometheus
+``/metrics`` page next to each replica engine's own registry.
+
+The router holds exactly ONE lock; engines and the metric registry have
+their own and never call back into the router while holding them, and tier
+futures are completed outside the lock — the lock graph stays acyclic by
+construction (and analysis/rules/concurrency.py checks this package).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from iwae_replication_project_tpu.serving.batcher import (
+    EngineOverloaded,
+    RequestTimeout,
+    complete_future,
+)
+from iwae_replication_project_tpu.telemetry.registry import MetricRegistry
+
+__all__ = ["ReplicaRouter", "TierOverloaded", "ReplicaUnavailable"]
+
+
+class TierOverloaded(RuntimeError):
+    """The tier-wide outstanding-request ceiling is hit; back off/retry."""
+
+
+class ReplicaUnavailable(RuntimeError):
+    """No healthy replica can take the request (fleet down or draining)."""
+
+
+@dataclasses.dataclass
+class _Tracked:
+    """One admitted request's tier-level state (owned by the router)."""
+
+    ticket: int
+    op: str
+    row: Any                      # validated payload row (np [d])
+    k: Optional[int]
+    seed: int
+    future: Future
+    attempts: int = 0
+    replica_index: int = -1
+    t_dispatch: float = 0.0
+    #: set (under the router lock) exactly once, when the tier future is
+    #: completed — guards the outstanding-count decrement against the
+    #: duplicate completions rerouting can produce
+    finalized: bool = False
+
+
+class _Replica:
+    """One engine plus its routing state. Deliberately lock-free: every
+    mutable field is guarded by the owning router's single lock, so the
+    fleet has one synchronization domain, not N+1."""
+
+    __slots__ = ("index", "engine", "healthy", "outstanding", "last_error")
+
+    def __init__(self, index: int, engine):
+        self.index = index
+        self.engine = engine
+        self.healthy = True
+        #: ticket -> _Tracked currently dispatched here (inflight = len)
+        self.outstanding: Dict[int, _Tracked] = {}
+        self.last_error: Optional[str] = None
+
+
+class ReplicaRouter:
+    """Least-inflight, affinity-aware dispatch over N engine replicas.
+
+    ``engines`` share weights and config (the tier builds them that way);
+    anything with the engine surface used here — ``submit(op, row, k=,
+    seed=)`` returning a Future, ``stop()``, ``row_dims``, ``k`` — routes,
+    so tests drive the full policy with fake engines and no device.
+    """
+
+    def __init__(self, engines: Sequence, *, max_outstanding: int = 4096,
+                 affinity_slack: int = 2, stall_deadline_s: float = 30.0,
+                 probe_timeout_s: float = 5.0,
+                 probe_op: str = "score",
+                 registry: Optional[MetricRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if not engines:
+            raise ValueError("a router needs at least one replica engine")
+        if max_outstanding < 1:
+            raise ValueError(
+                f"max_outstanding must be >= 1, got {max_outstanding}")
+        self.registry = registry if registry is not None else MetricRegistry()
+        self.max_outstanding = int(max_outstanding)
+        self.affinity_slack = int(affinity_slack)
+        self.stall_deadline_s = float(stall_deadline_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.probe_op = probe_op
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._empty = threading.Condition(self._lock)
+        self._replicas = [_Replica(i, e) for i, e in enumerate(engines)]
+        self._affinity: Dict[Tuple[str, Optional[int]], int] = {}
+        self._seed_counter = 0
+        self._ticket_counter = 0
+        self._outstanding_total = 0
+        self._closed = False
+        self._monitor: Optional[threading.Thread] = None
+        self._monitor_stop = threading.Event()
+        self.registry.gauge("router/replicas").set(len(self._replicas))
+        self.registry.gauge("router/outstanding").set(0)
+        for r in self._replicas:
+            self._publish_replica(r)
+        # pre-register the counter schema so /metrics carries every router
+        # counter from the first scrape (same idiom as ServingMetrics)
+        for name in ("routed", "completed", "errors", "reroutes", "sheds",
+                     "quota_rejections", "replica_failures", "affinity_hits",
+                     "stall_drains", "probe_readmits", "probes"):
+            self.registry.counter(f"router/{name}")
+
+    # -- metric plumbing ---------------------------------------------------
+
+    def _count(self, name: str, n: int = 1) -> None:
+        self.registry.counter(f"router/{name}").inc(n)
+
+    def _publish_replica(self, r: _Replica) -> None:
+        """Per-replica gauges (caller holds the lock or is __init__)."""
+        self.registry.gauge(f"router/inflight/r{r.index}").set(
+            len(r.outstanding))
+        self.registry.gauge(f"router/healthy/r{r.index}").set(
+            1 if r.healthy else 0)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def engines(self) -> List:
+        return [r.engine for r in self._replicas]
+
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return self._outstanding_total
+
+    def replica_states(self) -> List[dict]:
+        with self._lock:
+            return [{"index": r.index, "healthy": r.healthy,
+                     "inflight": len(r.outstanding),
+                     "last_error": r.last_error} for r in self._replicas]
+
+    # -- request intake ----------------------------------------------------
+
+    def submit(self, op: str, row, k: Optional[int] = None, *,
+               seed: Optional[int] = None) -> Future:
+        """Admit and dispatch one request row; returns the tier Future.
+
+        Raises synchronously for non-serving outcomes the caller must turn
+        into typed responses: ValueError (bad payload/op, via the engine's
+        own validation), :class:`TierOverloaded` (ceiling),
+        :class:`EngineOverloaded` (every healthy replica shed), and
+        :class:`ReplicaUnavailable` (no healthy replica / draining). Once
+        a Future is returned, it ALWAYS completes — with a result, or with
+        one of the typed errors above, or :class:`~..batcher.RequestTimeout`.
+        """
+        k = int(k) if k is not None else None
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                raise ReplicaUnavailable(
+                    "serving tier is draining; no new requests")
+            if self._outstanding_total >= self.max_outstanding:
+                self._count("sheds")
+                raise TierOverloaded(
+                    f"tier ceiling hit ({self.max_outstanding} requests "
+                    f"outstanding); shedding — retry with backoff")
+            if seed is None:
+                seed = self._seed_counter
+                self._seed_counter = (self._seed_counter + 1) % (2 ** 31)
+            self._ticket_counter += 1
+            t = _Tracked(ticket=self._ticket_counter, op=op, row=row, k=k,
+                         seed=int(seed), future=fut)
+            self._outstanding_total += 1
+            self.registry.gauge("router/outstanding").set(
+                self._outstanding_total)
+        try:
+            self._dispatch(t, exclude=set())
+        except Exception as e:
+            self._finalize(t, exc=e)
+            raise
+        self._count("routed")
+        return fut
+
+    # -- selection + dispatch ----------------------------------------------
+
+    def _select(self, group: Tuple[str, Optional[int]],
+                exclude: Set[int]) -> Optional[_Replica]:
+        """Pick a replica (caller holds the lock): sticky group affinity
+        while balanced, else least-inflight with lowest-index tie-break."""
+        cands = [r for r in self._replicas
+                 if r.healthy and r.index not in exclude]
+        if not cands:
+            return None
+        least = min(len(r.outstanding) for r in cands)
+        aff = self._affinity.get(group)
+        if aff is not None:
+            ar = self._replicas[aff]
+            if ar.healthy and aff not in exclude and \
+                    len(ar.outstanding) <= least + self.affinity_slack:
+                self._count("affinity_hits")
+                return ar
+        chosen = min(cands, key=lambda r: (len(r.outstanding), r.index))
+        self._affinity[group] = chosen.index
+        return chosen
+
+    def _dispatch(self, t: _Tracked, exclude: Set[int]) -> None:
+        """Place `t` on a replica, walking past sheds and submit-time
+        failures; raises the typed error when the fleet cannot take it."""
+        any_shed = False
+        while True:
+            with self._lock:
+                r = self._select((t.op, t.k), exclude)
+                if r is None:
+                    break
+                r.outstanding[t.ticket] = t
+                t.replica_index = r.index
+                t.attempts += 1
+                t.t_dispatch = self._clock()
+                self._publish_replica(r)
+            try:
+                # outside the lock: engine.submit takes the engine's own
+                # lock and may block briefly; the router lock never nests
+                # around foreign blocking work
+                ef = r.engine.submit(t.op, t.row, k=t.k, seed=t.seed)
+            except EngineOverloaded:
+                any_shed = True
+                self._unplace(t, r)
+                exclude.add(r.index)
+                continue
+            except ValueError:
+                self._unplace(t, r)
+                raise          # bad request: the engine's validation speaks
+            except Exception as e:
+                self._unplace(t, r)
+                self._replica_failed(r, e)
+                exclude.add(r.index)
+                continue
+            ef.add_done_callback(
+                lambda f, t=t, r=r: self._on_engine_done(t, r, f))
+            return
+        if any_shed:
+            self._count("sheds")
+            raise EngineOverloaded(
+                "every healthy replica shed the request (queues full); "
+                "retry with backoff")
+        raise ReplicaUnavailable("no healthy replica available")
+
+    def _unplace(self, t: _Tracked, r: _Replica) -> None:
+        with self._lock:
+            r.outstanding.pop(t.ticket, None)
+            self._publish_replica(r)
+
+    def _redispatch(self, t: _Tracked, exclude: Set[int],
+                    shed_exc: Optional[BaseException] = None) -> None:
+        """Callback-context dispatch: failures complete the future instead
+        of raising (there is no caller to raise to). ``shed_exc`` marks a
+        redispatch triggered by an async shed: if no peer can take the
+        request, the shedding replica is FULL, not gone — the caller must
+        see the original ``overloaded`` (back off and retry), never an
+        ``unavailable`` that reads as fleet-down."""
+        try:
+            self._dispatch(t, exclude)
+        except ReplicaUnavailable as e:
+            self._finalize(t, exc=shed_exc if shed_exc is not None else e)
+        except Exception as e:
+            self._finalize(t, exc=e)
+
+    # -- completion + failure paths ----------------------------------------
+
+    # tolerant completion (the engine's contract): duplicate completions
+    # from rerouted requests and caller-side cancellations must never kill
+    # a completion callback
+    _complete = staticmethod(complete_future)
+
+    def _finalize(self, t: _Tracked, result=None, exc=None) -> None:
+        """Complete the tier future (first completion wins) and retire the
+        request from the outstanding count exactly once."""
+        if exc is None:
+            delivered = self._complete(t.future, result=result)
+        else:
+            delivered = self._complete(t.future, exc=exc)
+        with self._lock:
+            if t.finalized:
+                return
+            t.finalized = True
+            self._outstanding_total -= 1
+            self.registry.gauge("router/outstanding").set(
+                self._outstanding_total)
+            self._empty.notify_all()
+        if delivered:
+            self._count("completed" if exc is None else "errors")
+
+    def _on_engine_done(self, t: _Tracked, r: _Replica, ef: Future) -> None:
+        """Engine-future callback (runs on the replica's completion/dispatch
+        thread). Success is delivered first-wins; an error from the replica
+        currently owning the request marks it unhealthy, drains it, and
+        reroutes; errors from abandoned (already-rerouted) dispatches are
+        dropped — the live dispatch is authoritative."""
+        with self._lock:
+            owns = r.outstanding.get(t.ticket) is t
+            if owns:
+                del r.outstanding[t.ticket]
+                self._publish_replica(r)
+        exc = ef.exception()
+        if exc is None:
+            self._finalize(t, result=ef.result())
+            return
+        if not owns or t.finalized:
+            return
+        if isinstance(exc, RequestTimeout):
+            # the request's own deadline passed inside the replica: a typed
+            # per-request outcome, not a replica failure — no reroute (its
+            # deadline has already passed; a retry would serve it late)
+            self._finalize(t, exc=exc)
+            return
+        if isinstance(exc, EngineOverloaded):
+            # an async shed (remote replicas — frontend/remote.py — deliver
+            # sheds through the future): the replica is FULL, not failed;
+            # try its peers without marking it unhealthy
+            if t.attempts <= len(self._replicas):
+                self._count("reroutes")
+                self._redispatch(t, exclude={r.index}, shed_exc=exc)
+            else:
+                self._finalize(t, exc=exc)
+            return
+        self._replica_failed(r, exc)
+        if t.attempts <= len(self._replicas):
+            self._count("reroutes")
+            self._redispatch(t, exclude={r.index})
+        else:
+            self._finalize(t, exc=exc)
+
+    def _replica_failed(self, r: _Replica, exc: BaseException) -> None:
+        """Mark `r` unhealthy (once) and reroute everything it still holds."""
+        with self._lock:
+            was_healthy = r.healthy
+            r.healthy = False
+            r.last_error = f"{type(exc).__name__}: {exc}"
+            drained = list(r.outstanding.values())
+            r.outstanding.clear()
+            self._publish_replica(r)
+        if was_healthy:
+            self._count("replica_failures")
+        for other in drained:
+            self._count("reroutes")
+            self._redispatch(other, exclude={r.index})
+
+    # -- health: stall detection + warm-probe re-admission ------------------
+
+    def check_stalls(self, now: Optional[float] = None) -> int:
+        """Drain any healthy replica whose OLDEST in-flight request has
+        been outstanding longer than ``stall_deadline_s`` (a wedged engine
+        backs up its window without ever raising). Returns the number of
+        replicas drained. Called by the monitor thread; callable directly
+        (tests drive it with a fake clock)."""
+        now = self._clock() if now is None else now
+        stalled: List[_Replica] = []
+        with self._lock:
+            for r in self._replicas:
+                if r.healthy and r.outstanding:
+                    oldest = min(t.t_dispatch
+                                 for t in r.outstanding.values())
+                    if now - oldest > self.stall_deadline_s:
+                        stalled.append(r)
+        for r in stalled:
+            self._count("stall_drains")
+            self._replica_failed(r, RequestTimeout(
+                f"replica r{r.index} stalled: oldest in-flight request "
+                f"exceeded {self.stall_deadline_s}s"))
+        return len(stalled)
+
+    def probe_unhealthy(self) -> int:
+        """Warm-probe every unhealthy replica with one real request through
+        its warmed program; a probe that completes in time re-admits the
+        replica. Returns the number re-admitted."""
+        with self._lock:
+            down = [r for r in self._replicas if not r.healthy]
+            if not down:
+                return 0
+            template = self._replicas[0].engine
+        dims = template.row_dims[self.probe_op]
+        k = getattr(template, "k", None)
+        readmitted = 0
+        for r in down:
+            self._count("probes")
+            try:
+                probe_row = [0.0] * dims
+                ef = r.engine.submit(self.probe_op, probe_row, k=k, seed=0)
+                ef.result(timeout=self.probe_timeout_s)
+            except Exception:
+                continue      # still down; next monitor tick retries
+            with self._lock:
+                r.healthy = True
+                r.last_error = None
+                self._publish_replica(r)
+            self._count("probe_readmits")
+            readmitted += 1
+        return readmitted
+
+    def start_monitor(self, interval_s: float = 0.25) -> None:
+        """Background health loop: stall sweep + re-admission probes."""
+        if self._monitor is not None:
+            return
+        self._monitor_stop.clear()
+
+        def loop():
+            while not self._monitor_stop.wait(interval_s):
+                self.check_stalls()
+                self.probe_unhealthy()
+
+        self._monitor = threading.Thread(target=loop,
+                                         name="iwae-tier-monitor",
+                                         daemon=True)
+        self._monitor.start()
+
+    def stop_monitor(self) -> None:
+        if self._monitor is not None:
+            self._monitor_stop.set()
+            self._monitor.join()
+            self._monitor = None
+
+    # -- drain --------------------------------------------------------------
+
+    def drain(self, timeout_s: float = 60.0) -> None:
+        """Stop intake, flush every replica (``engine.stop()`` dispatches
+        queued work and completes all in-flight futures), wait for the
+        outstanding count to hit zero, and error-complete anything left
+        (replicas that died mid-drain) with :class:`ReplicaUnavailable` —
+        zero accepted requests are ever lost to a shutdown."""
+        with self._lock:
+            self._closed = True
+        self.stop_monitor()
+        for r in self._replicas:
+            try:
+                r.engine.stop()
+            except Exception as e:
+                self._replica_failed(r, e)
+        deadline = self._clock() + timeout_s
+        with self._empty:
+            while self._outstanding_total > 0:
+                remaining = deadline - self._clock()
+                if remaining <= 0 or not self._empty.wait(
+                        timeout=min(remaining, 0.25)):
+                    if self._clock() >= deadline:
+                        break
+        leftovers: List[_Tracked] = []
+        with self._lock:
+            for r in self._replicas:
+                leftovers.extend(r.outstanding.values())
+                r.outstanding.clear()
+                self._publish_replica(r)
+        for t in leftovers:
+            self._finalize(t, exc=ReplicaUnavailable(
+                "tier drained before the request completed (replica lost "
+                "mid-drain)"))

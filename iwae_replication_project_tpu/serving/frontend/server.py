@@ -1,0 +1,404 @@
+"""The TCP front end: admission control over the replica router.
+
+``ServingTier`` binds a listening socket and speaks the JSON-lines
+protocol (protocol.py): one daemon accept thread, one daemon thread per
+connection, responses completed out-of-order via future callbacks under a
+per-connection write lock. The request path per line is::
+
+    parse -> control op? (info: answered here)
+          -> global ceiling + per-client token bucket  (admission)
+          -> one router.submit per payload row          (routing)
+          -> response written when the last row lands   (completion)
+
+Admission failures are typed *responses* (protocol.ERROR_CODES) — a
+rejected request never drops the connection. Client identity stops at the
+quota check: nothing client-derived flows into the router or the engines,
+so per-client state can never reach an AOT program signature (the
+multi-client zero-recompile test pins this).
+
+Shutdown (:meth:`stop`) is a graceful drain: the listener closes first
+(no new connections), the router drains every replica via
+``engine.stop()``, each connection finishes writing its pending responses,
+and only then do the sockets close — zero accepted requests go
+unanswered. Requests arriving mid-drain get typed ``unavailable``
+responses.
+
+The tier is transport only: batching/padding/AOT policy live in the
+replica engines, routing/health policy in router.py — this module never
+imports jax and is fully exercised by tests over localhost sockets.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from iwae_replication_project_tpu.serving.frontend import protocol
+from iwae_replication_project_tpu.serving.frontend.quotas import (
+    ClientQuotas,
+    QuotaExceeded,
+    QuotaPolicy,
+)
+from iwae_replication_project_tpu.serving.frontend.router import ReplicaRouter
+
+__all__ = ["ServingTier"]
+
+
+class _Pending:
+    """One in-flight request's per-row completion state (guarded by the
+    owning connection's lock)."""
+
+    __slots__ = ("req_id", "results", "remaining", "error")
+
+    def __init__(self, req_id: Any, n_rows: int):
+        self.req_id = req_id
+        self.results: List[Any] = [None] * n_rows
+        self.remaining = n_rows
+        self.error: Optional[BaseException] = None
+
+
+class _Connection:
+    """One client connection: a blocking read loop plus callback-driven
+    response writes. All mutable state (pending map, closed flag) and the
+    socket write side live under ONE lock; the read loop never holds it."""
+
+    def __init__(self, tier: "ServingTier", sock: socket.socket, peer):
+        self._tier = tier
+        self._sock = sock
+        self._peer = peer
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._pending = 0
+        self._dead = False
+
+    # -- writes (any thread) ------------------------------------------------
+
+    def _write(self, obj: Dict[str, Any]) -> None:
+        data = protocol.encode_line(obj)
+        with self._lock:
+            if self._dead:
+                return
+            try:
+                self._sock.sendall(data)
+            except OSError:
+                # the client vanished; the response was produced — nothing
+                # to deliver it to. Reads will fail and retire the loop.
+                self._dead = True
+
+    def _respond_error(self, req_id: Any, exc: BaseException) -> None:
+        self._write(protocol.error_response(
+            req_id, protocol.error_code_for(exc),
+            f"{type(exc).__name__}: {exc}"))
+
+    # -- request handling (read-loop thread + future callbacks) -------------
+
+    def _row_done(self, pending: _Pending, i: int, fut) -> None:
+        exc = fut.exception()
+        with self._lock:
+            if exc is not None and pending.error is None:
+                pending.error = exc
+            elif exc is None:
+                r = fut.result()
+                pending.results[i] = r.tolist() if hasattr(r, "tolist") else r
+            pending.remaining -= 1
+            finished = pending.remaining == 0
+        if not finished:
+            return
+        if pending.error is not None:
+            self._respond_error(pending.req_id, pending.error)
+        else:
+            self._write(protocol.ok_response(pending.req_id, pending.results))
+        with self._lock:
+            self._pending -= 1
+            self._idle.notify_all()
+
+    def _handle(self, line: bytes) -> None:
+        try:
+            obj = protocol.decode_line(line)
+        except protocol.ProtocolError as e:
+            self._respond_error(None, e)
+            return
+        req_id = obj.get("id")
+        op = obj.get("op")
+        if op in protocol.CONTROL_OPS:
+            doc = self._tier.info() if op == "info" else self._tier.stats()
+            self._write(protocol.ok_response(req_id, doc))
+            return
+        try:
+            rows = _payload_rows(obj)
+            client = obj.get("client")
+            if client is not None and not isinstance(client, str):
+                raise protocol.ProtocolError(
+                    f"'client' must be a string, got "
+                    f"{type(client).__name__}")
+            k = obj.get("k")
+            if k is not None:
+                k = int(k)
+            seed = obj.get("seed")
+            if seed is not None:
+                # the fleet-composition hook (protocol.py): one seed names
+                # one row's RNG stream, so it only makes sense row-wise
+                if not isinstance(seed, int) or isinstance(seed, bool):
+                    raise protocol.ProtocolError(
+                        f"'seed' must be an integer, got "
+                        f"{type(seed).__name__}")
+                if not 0 <= seed < 2 ** 31:
+                    # seeds ride the engines' int32 seed tensor; an
+                    # out-of-range value must die HERE as this client's
+                    # bad_request, not inside a replica where it would
+                    # error a whole coalesced batch and read as a
+                    # replica failure
+                    raise protocol.ProtocolError(
+                        f"'seed' must be in [0, 2**31), got {seed}")
+                if len(rows) != 1:
+                    raise protocol.ProtocolError(
+                        "'seed' applies to single-row payloads only")
+            self._tier.admit(client, len(rows))
+            pending = _Pending(req_id, len(rows))
+            with self._lock:
+                self._pending += 1
+            futures = []
+            try:
+                for row in rows:
+                    futures.append(
+                        self._tier.router.submit(op, row, k=k, seed=seed))
+            except Exception:
+                # partial admission: rows already routed complete and are
+                # discarded; the request as a unit gets the typed error —
+                # and its full quota cost back (the client pays for served
+                # requests, not for shed/rejected ones)
+                self._tier.refund(client, len(rows))
+                with self._lock:
+                    self._pending -= 1
+                    self._idle.notify_all()
+                raise
+            for i, f in enumerate(futures):
+                f.add_done_callback(
+                    lambda fut, i=i, p=pending: self._row_done(p, i, fut))
+        except Exception as e:
+            self._respond_error(req_id, e)
+
+    def serve(self) -> None:
+        """The read loop (own daemon thread): handle lines until EOF or a
+        socket error, then wait for pending responses to flush and close."""
+        reader = protocol.LineReader(self._sock)
+        try:
+            while True:
+                try:
+                    line = reader.next_line()
+                except (protocol.ProtocolError, OSError):
+                    break
+                if line is None:
+                    break
+                if line.strip():
+                    self._handle(line)
+        finally:
+            self.flush(timeout_s=60.0)
+            self.close()
+            self._tier._forget(self)
+
+    def flush(self, timeout_s: float) -> bool:
+        """Wait until every accepted request on this connection has been
+        answered (the drain contract). Returns False on timeout."""
+        with self._idle:
+            return self._idle.wait_for(lambda: self._pending == 0,
+                                       timeout=timeout_s)
+
+    def close(self) -> None:
+        with self._lock:
+            self._dead = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+def _engine_counters(engine) -> Dict[str, Any]:
+    """One replica engine's counter snapshot for :meth:`ServingTier.stats`
+    (fakes without a metrics registry report empty)."""
+    metrics = getattr(engine, "metrics", None)
+    if metrics is None:
+        return {}
+    return dict(metrics.snapshot()["counters"])
+
+
+def _payload_rows(obj: Dict[str, Any]) -> List[Any]:
+    """The request's ``x`` as a list of rows (single-row payloads wrap)."""
+    x = obj.get("x")
+    if not isinstance(x, (list, tuple)) or len(x) == 0:
+        raise protocol.ProtocolError(
+            "'x' must be a non-empty row or list of rows")
+    if isinstance(x[0], (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class ServingTier:
+    """N engine replicas + router + quotas behind one TCP endpoint.
+
+    ``engines`` are ready-made replicas over shared weights (the CLI and
+    :func:`build_tier_engines` construct them; tests pass fakes). The tier
+    owns their lifecycle from :meth:`start` to :meth:`stop`. ``port=0``
+    binds an ephemeral port (read :attr:`port` after ``start``).
+    """
+
+    def __init__(self, engines: Sequence, *,
+                 quota: Optional[QuotaPolicy] = None,
+                 max_outstanding: int = 4096,
+                 host: str = "127.0.0.1", port: int = 0,
+                 affinity_slack: int = 2,
+                 stall_deadline_s: float = 30.0,
+                 probe_timeout_s: float = 5.0,
+                 monitor_interval_s: float = 0.25,
+                 registry=None):
+        self.router = ReplicaRouter(
+            engines, max_outstanding=max_outstanding,
+            affinity_slack=affinity_slack,
+            stall_deadline_s=stall_deadline_s,
+            probe_timeout_s=probe_timeout_s, registry=registry)
+        self.registry = self.router.registry
+        self.quotas = ClientQuotas(quota)
+        self._quota = quota
+        self._host = host
+        self._requested_port = port
+        self._monitor_interval_s = monitor_interval_s
+        self._lock = threading.Lock()
+        self._conns: set = set()
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+
+    # -- admission ----------------------------------------------------------
+
+    def admit(self, client: Optional[str], cost: int) -> None:
+        """Per-client token-bucket admission (the router applies the global
+        ceiling itself at submit). Raises :class:`QuotaExceeded`."""
+        try:
+            self.quotas.admit(client, cost)
+        except QuotaExceeded:
+            self.registry.counter("router/quota_rejections").inc()
+            raise
+
+    def refund(self, client: Optional[str], cost: int) -> None:
+        """Return an admitted request's tokens when routing rejected it
+        (ceiling/shed/unavailable): the quota meters served work, so a
+        request whose response is a typed routing error costs nothing."""
+        self.quotas.refund(client, cost)
+
+    # -- info ---------------------------------------------------------------
+
+    def info(self) -> Dict[str, Any]:
+        """The ``{"op": "info"}`` control response: what clients need to
+        size payloads and pace themselves."""
+        template = self.router.engines[0]
+        return {
+            "ops": sorted(template.row_dims),
+            "row_dims": dict(template.row_dims),
+            "k": getattr(template, "k", None),
+            "buckets": list(getattr(getattr(template, "ladder", None),
+                                    "buckets", ())),
+            "replicas": len(self.router.engines),
+            "max_outstanding": self.router.max_outstanding,
+            "quota": ({"rate": self._quota.rate, "burst": self._quota.burst}
+                      if self._quota is not None else None),
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        """The ``{"op": "stats"}`` control response: live router counters
+        and gauges plus each replica engine's own counter snapshot — the
+        over-the-wire view the bench's zero-recompile proof and the smoke's
+        failure accounting read (same numbers the CLI prints at shutdown)."""
+        snap = self.registry.snapshot()
+        return {
+            "router": {name: v for name, v in snap["counters"].items()
+                       if name.startswith("router/")},
+            "gauges": {name: v for name, v in snap["gauges"].items()
+                       if name.startswith("router/")},
+            "replicas": self.router.replica_states(),
+            "engines": [_engine_counters(e) for e in self.router.engines],
+        }
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def warmup(self, ops: Iterable[str] = ("score", "encode", "decode"),
+               ks=None) -> Dict[str, float]:
+        """Warm every replica's bucket ladder (AOT pre-compile); replicas
+        share the process AOT registry in-process, so replica 2+ warmups
+        are registry hits. Returns summed warmup stats."""
+        total: Dict[str, float] = {}
+        for e in self.router.engines:
+            w = e.warmup(ops=tuple(ops), ks=ks)
+            for key, v in w.items():
+                total[key] = total.get(key, 0.0) + v
+        return total
+
+    def start(self) -> "ServingTier":
+        """Start replicas, the health monitor, and the accept loop."""
+        for e in self.router.engines:
+            e.start()
+        self.router.start_monitor(self._monitor_interval_s)
+        if self._listener is None:
+            lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            lst.bind((self._host, self._requested_port))
+            lst.listen(128)
+            # a plain close() does not reliably wake a thread blocked in
+            # accept() on Linux; a short accept timeout lets the loop poll
+            # the stopping flag instead
+            lst.settimeout(0.2)
+            self._listener = lst
+            self._stopping.clear()
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, args=(lst,),
+                name="iwae-tier-accept", daemon=True)
+            self._accept_thread.start()
+        return self
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._listener.getsockname()[1] if self._listener else None
+
+    def _accept_loop(self, listener: socket.socket) -> None:
+        while not self._stopping.is_set():
+            try:
+                sock, peer = listener.accept()
+            except socket.timeout:
+                continue        # poll the stopping flag
+            except OSError:
+                return          # listener closed: shutdown
+            sock.settimeout(None)   # connections block; accept timeout off
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _Connection(self, sock, peer)
+            with self._lock:
+                self._conns.add(conn)
+            threading.Thread(target=conn.serve,
+                             name=f"iwae-tier-conn-{peer[1]}",
+                             daemon=True).start()
+
+    def _forget(self, conn: _Connection) -> None:
+        with self._lock:
+            self._conns.discard(conn)
+
+    def stop(self, timeout_s: float = 60.0) -> None:
+        """Graceful drain: stop accepting, flush all replicas, answer
+        everything, then close connections. Idempotent."""
+        self._stopping.set()
+        listener = self._listener
+        self._listener = None
+        if listener is not None:
+            listener.close()
+        if self._accept_thread is not None:
+            self._accept_thread.join()
+            self._accept_thread = None
+        # drain the fleet: every tier future completes (result or typed
+        # error) before this returns
+        self.router.drain(timeout_s=timeout_s)
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            c.flush(timeout_s=timeout_s)
+            c.close()
+        with self._lock:
+            self._conns.clear()

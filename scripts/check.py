@@ -13,8 +13,11 @@ machine-readable summary.
    eval scorer, the three serving programs, all hot-loop paths);
 3. **telemetry smoke** (scripts/telemetry_smoke.py);
 4. **serving smoke** (scripts/serving_smoke.py);
-5. **hot-loop smoke** (scripts/hot_loop_smoke.py);
-6. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
+5. **serving tier smoke** (scripts/serving_tier_smoke.py) — the network
+   tier over a real socket with a replica killed mid-burst: zero lost
+   responses, zero recompiles, bitwise parity with a direct engine;
+6. **hot-loop smoke** (scripts/hot_loop_smoke.py);
+7. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
    ``--sanitize`` armed.
 
 Every full-gate run writes ``results/check_summary.json`` (per-stage status,
@@ -137,6 +140,12 @@ def run_serving_smoke() -> dict:
                                                   "serving_smoke.py")])
 
 
+def run_serving_tier_smoke() -> dict:
+    return run_step("serving tier smoke",
+                    [sys.executable, os.path.join("scripts",
+                                                  "serving_tier_smoke.py")])
+
+
 def run_hot_loop_smoke() -> dict:
     return run_step("hot-loop smoke",
                     [sys.executable, os.path.join("scripts",
@@ -182,6 +191,7 @@ def main(argv=None) -> int:
     if not single_stage:
         stages.append(run_telemetry_smoke())
         stages.append(run_serving_smoke())
+        stages.append(run_serving_tier_smoke())
         stages.append(run_hot_loop_smoke())
     if not args.lint_only:
         stages.append(run_tests(passthrough))

@@ -1,0 +1,187 @@
+"""Serving-tier smoke stage for scripts/check.py.
+
+One short CPU process that proves the network tier's two hard fleet
+invariants with REAL engines, a REAL socket client, and a replica killed
+mid-burst:
+
+1. **zero lost responses** — a ragged burst through the TCP front end
+   (serving/frontend/) with one of the two replicas killed while its work
+   is in flight: every accepted request still gets an ``ok`` response (the
+   router reroutes the dead replica's work with the ORIGINAL seeds) and the
+   rerouted results are bitwise identical to a direct single-engine run of
+   the same rows;
+2. **zero recompiles** — after :meth:`ServingTier.warmup` the whole ragged
+   stream, reroutes included, is AOT-registry hits (no ``aot_misses``, no
+   persistent-cache misses): routing and failure handling never perturb
+   program shapes.
+
+The replica kill is injected through a thin proxy that errors the replica's
+in-flight futures and refuses new submits — exactly the signal surface the
+router sees when an engine dies for real (the engine's own tolerant future
+completion makes the late real results harmless). Uses the same deliberately
+tiny architecture as serving_smoke.py: this checks fleet plumbing, not
+throughput — ``bench.py --serving`` owns the numbers.
+
+Exit 0 on success, 1 with a message on the first failed check.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class KillableReplica:
+    """Engine proxy with an induced-death switch (the smoke's fault
+    injector): ``kill()`` errors every in-flight future and makes further
+    submits raise — the router must mark it unhealthy and reroute."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.row_dims = engine.row_dims
+        self.k = engine.k
+        self._lock = threading.Lock()
+        self._live = []
+        self.killed = False
+        self.submitted = 0
+
+    def submit(self, op, row, k=None, *, seed=None):
+        with self._lock:
+            if self.killed:
+                raise RuntimeError("replica killed (smoke fault injection)")
+        f = self.engine.submit(op, row, k=k, seed=seed)
+        with self._lock:
+            self._live.append(f)
+            self.submitted += 1
+        return f
+
+    def kill(self):
+        with self._lock:
+            self.killed = True
+            live, self._live = self._live, []
+        for f in live:
+            try:
+                f.set_exception(
+                    RuntimeError("replica killed (smoke fault injection)"))
+            except Exception:
+                pass        # already completed: nothing in flight to lose
+
+    def start(self):
+        self.engine.start()
+
+    def stop(self, timeout_s=60.0):
+        self.engine.stop()
+
+    def warmup(self, ops=(), ks=None):
+        return self.engine.warmup(ops=tuple(ops), ks=ks)
+
+
+def main() -> int:
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        setup_persistent_cache)
+
+    # warm-path discipline, like every entry point: repeated CI runs
+    # deserialize the serving programs instead of recompiling them
+    setup_persistent_cache(base_dir=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+    import numpy as np
+
+    from iwae_replication_project_tpu.models import iwae as model
+    from iwae_replication_project_tpu.serving import ServingEngine
+    from iwae_replication_project_tpu.serving.frontend import (
+        ServingTier, TierClient)
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        cache_stats, stats_delta)
+
+    D = 32
+    cfg = model.ModelConfig(x_dim=D, n_hidden_enc=(16, 8), n_latent_enc=(8, 4),
+                            n_hidden_dec=(8, 16), n_latent_dec=(8, D))
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+
+    def engine():
+        return ServingEngine(params=params, model_config=cfg, k=4,
+                             max_batch=8, max_inflight=2, timeout_s=30.0)
+
+    rng = np.random.RandomState(0)
+    sizes = (1, 3, 7, 2, 8, 5, 1, 4, 6, 2)
+    x = (rng.rand(sum(sizes), D) > 0.5).astype(np.float32)
+
+    # the parity reference: ONE direct engine, same rows in the same order
+    # (seed minting is arrival-order on both sides)
+    direct = engine()
+    direct.warmup(ops=("score",))
+    ref = direct.score(x)
+    direct.stop()
+
+    # the tier: two replicas (one killable) on an ephemeral port
+    victim = KillableReplica(engine())
+    tier = ServingTier([victim, engine()], port=0, monitor_interval_s=0.05)
+    warm = tier.warmup(ops=("score",))
+    assert warm["programs"] > 0, warm
+    tier.start()
+    s0 = cache_stats()
+
+    # ragged burst from a real socket client; kill replica 0 mid-burst
+    # (half the stream written, and the victim confirmed holding work —
+    # the server reads the socket asynchronously, so without the wait the
+    # kill could land before any row reached the victim)
+    import time as _time
+    with TierClient("127.0.0.1", tier.port) as cli:
+        ids, off = [], 0
+        for i, n in enumerate(sizes):
+            ids.append((cli.submit("score", x[off:off + n].tolist()), n, off))
+            off += n
+            if i == len(sizes) // 2:
+                deadline = _time.monotonic() + 10.0
+                while victim.submitted == 0:
+                    assert _time.monotonic() < deadline, \
+                        "victim replica never received work"
+                    _time.sleep(0.002)
+                victim.kill()
+        responses = cli.drain([rid for rid, _, _ in ids])
+        stats = cli.stats()
+
+    # zero lost responses: every accepted request answered, all ok (the
+    # killed replica's work rerouted, not errored — the fleet had a healthy
+    # peer), and rerouted results bitwise-match the direct run
+    assert len(responses) == len(ids), "burst responses lost"
+    bad = [responses[rid] for rid, _, _ in ids if not responses[rid]["ok"]]
+    assert not bad, f"requests failed despite a healthy peer: {bad[:2]}"
+    out = np.concatenate([np.asarray(responses[rid]["result"], ref.dtype)
+                          for rid, _, _ in ids])
+    assert np.array_equal(out, ref), \
+        "fleet results (with mid-burst kill) differ from the direct engine"
+
+    # the router saw the death and rerouted
+    r = stats["router"]
+    assert r["router/replica_failures"] == 1, r
+    assert r["router/reroutes"] >= 1, r
+    assert [rep["healthy"] for rep in stats["replicas"]].count(False) == 1, \
+        stats["replicas"]
+
+    # zero recompiles across the whole post-warmup stream, reroutes included
+    d = stats_delta(s0)
+    assert d["aot_misses"] == 0, f"tier burst compiled: {d}"
+    assert d["persistent_cache_misses"] == 0, f"XLA recompiled: {d}"
+
+    # graceful drain: stop answers everything and leaves nothing in flight
+    tier.stop(timeout_s=30)
+    assert tier.router.outstanding == 0, "drain left requests outstanding"
+
+    print(f"serving tier smoke OK: {len(ids)} requests / {len(x)} rows over "
+          f"TCP, replica killed mid-burst, {r['router/reroutes']} reroutes, "
+          f"0 lost, 0 recompiles, bitwise == direct engine")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"serving tier smoke FAILED: {e}", file=sys.stderr)
+        sys.exit(1)

@@ -1,0 +1,810 @@
+"""Serving-tier tests: router policy (fake engines, no device), quota state
+machine (fake clock), wire protocol, the TCP front end over real sockets,
+RemoteEngine fleet composition, and the real-engine integration pins (fleet
+vs direct-engine bitwise parity; multi-client stream causes zero recompiles).
+
+The router/quota/protocol layers are deliberately device-free: everything
+with the engine surface (``submit(op, row, k=, seed=)`` -> Future, ``stop``,
+``row_dims``, ``k``) routes, so the whole failure model — reroute, stall
+drain, probe re-admission, graceful drain — is pinned with fakes at
+fake-clock speed. Only the two integration tests at the bottom build real
+(tiny) engines.
+"""
+
+import json
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu.serving.batcher import (
+    EngineOverloaded,
+    RequestTimeout,
+)
+from iwae_replication_project_tpu.serving.frontend import (
+    ClientQuotas,
+    QuotaExceeded,
+    QuotaPolicy,
+    RemoteEngine,
+    ReplicaRouter,
+    ReplicaUnavailable,
+    ServingTier,
+    TierClient,
+    TierOverloaded,
+)
+from iwae_replication_project_tpu.serving.frontend import protocol
+from iwae_replication_project_tpu.serving.frontend.client import TierError
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class FakeEngine:
+    """The engine surface with scripted behavior and manual completion.
+
+    ``mode``:
+      * "auto"  — submits complete immediately with ``seed * 1000 + sum(row)``
+                  (seed-dependent so reroute-with-same-seed is checkable);
+      * "manual"— futures are held; tests complete them via :meth:`finish`;
+      * "shed"  — every submit raises :class:`EngineOverloaded`;
+      * "raise" — every submit raises RuntimeError (submit-time failure).
+    """
+
+    def __init__(self, mode="auto", dims=4):
+        self.mode = mode
+        self.row_dims = {"score": dims, "encode": dims, "decode": dims}
+        self.k = 5
+        self.lock = threading.Lock()
+        self.held = []            # (op, row, k, seed, future) in manual mode
+        self.submitted = 0
+        self.stopped = False
+
+    @staticmethod
+    def value(row, seed):
+        return float(seed) * 1000.0 + float(sum(row))
+
+    def submit(self, op, row, k=None, *, seed=None):
+        with self.lock:
+            if self.mode == "shed":
+                raise EngineOverloaded("queue full")
+            if self.mode == "raise":
+                raise RuntimeError("device on fire")
+            self.submitted += 1
+            f = Future()
+            if self.mode == "manual":
+                self.held.append((op, list(row), k, seed, f))
+            else:
+                f.set_result(self.value(row, seed))
+            return f
+
+    def finish(self, n=None, exc=None):
+        """Complete the first `n` held futures (all by default), each with
+        its seed-derived value or `exc`."""
+        with self.lock:
+            batch, self.held = (self.held[:n], self.held[n:]) if n else \
+                (self.held, [])
+        for _, row, _, seed, f in batch:
+            try:
+                if exc is not None:
+                    f.set_exception(exc)
+                else:
+                    f.set_result(self.value(row, seed))
+            except Exception:
+                pass
+        return len(batch)
+
+    def start(self):
+        pass
+
+    def stop(self, timeout_s=None):
+        self.stopped = True
+        self.finish()
+
+    def warmup(self, ops=(), ks=None):
+        return {"programs": 0.0}
+
+
+def wait_until(pred, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.002)
+
+
+# ---------------------------------------------------------------------------
+# router selection policy
+# ---------------------------------------------------------------------------
+
+def test_least_inflight_tie_break_lowest_index():
+    engines = [FakeEngine("manual") for _ in range(3)]
+    r = ReplicaRouter(engines, affinity_slack=0)
+    # distinct (op, k) per submit so affinity never applies; equal inflight
+    # must break to the lowest index each time
+    r.submit("score", [1, 1, 1, 1], k=1)
+    assert engines[0].submitted == 1
+    r.submit("score", [1, 1, 1, 1], k=2)
+    assert engines[1].submitted == 1
+    r.submit("score", [1, 1, 1, 1], k=3)
+    assert engines[2].submitted == 1
+    # now 1-1-1 inflight: next goes to index 0 again
+    r.submit("score", [1, 1, 1, 1], k=4)
+    assert engines[0].submitted == 2
+    for e in engines:
+        e.finish()
+    r.drain(timeout_s=5)
+
+
+def test_bucket_affinity_sticky_within_slack():
+    engines = [FakeEngine("manual") for _ in range(2)]
+    r = ReplicaRouter(engines, affinity_slack=2)
+    futs = [r.submit("score", [0, 0, 0, 0], k=7) for _ in range(3)]
+    # all three (score, 7) requests stick to replica 0: its inflight (1, 2)
+    # stays within slack of the idle peer
+    assert engines[0].submitted == 3 and engines[1].submitted == 0
+    assert r.registry.counter("router/affinity_hits").value >= 2
+    engines[0].finish()
+    for f in futs:
+        assert f.result(timeout=5) == FakeEngine.value([0, 0, 0, 0],
+                                                       f_seed(futs, f))
+    r.drain(timeout_s=5)
+
+
+def f_seed(futs, f):
+    """Seeds are minted in admission order starting at 0."""
+    return futs.index(f)
+
+
+def test_affinity_overridden_past_slack():
+    engines = [FakeEngine("manual") for _ in range(2)]
+    r = ReplicaRouter(engines, affinity_slack=1)
+    for _ in range(3):
+        r.submit("score", [0, 0, 0, 0], k=7)
+    # inflight now 2 on replica 0 vs 0 on replica 1: beyond slack 1, the
+    # third submit must have overridden affinity to the least-loaded peer
+    assert engines[0].submitted == 2 and engines[1].submitted == 1
+    for e in engines:
+        e.finish()
+    r.drain(timeout_s=5)
+
+
+def test_seed_minting_admission_order_and_explicit_seed():
+    eng = FakeEngine("auto")
+    r = ReplicaRouter([eng])
+    got = [r.submit("score", [0, 0, 0, 0]).result(timeout=5)
+           for _ in range(3)]
+    assert got == [0.0, 1000.0, 2000.0]       # minted seeds 0, 1, 2
+    # an explicit seed rides through untouched and does not advance minting
+    assert r.submit("score", [0, 0, 0, 0],
+                    seed=77).result(timeout=5) == 77000.0
+    assert r.submit("score", [0, 0, 0, 0]).result(timeout=5) == 3000.0
+    r.drain(timeout_s=5)
+
+
+# ---------------------------------------------------------------------------
+# admission ceiling + shedding
+# ---------------------------------------------------------------------------
+
+def test_tier_ceiling_sheds_typed():
+    engines = [FakeEngine("manual")]
+    r = ReplicaRouter(engines, max_outstanding=2)
+    r.submit("score", [0, 0, 0, 0])
+    r.submit("score", [0, 0, 0, 0])
+    with pytest.raises(TierOverloaded):
+        r.submit("score", [0, 0, 0, 0])
+    assert r.registry.counter("router/sheds").value == 1
+    engines[0].finish()
+    # completions free ceiling slots
+    r.submit("score", [0, 0, 0, 0]).cancel()
+    r.drain(timeout_s=5)
+
+
+def test_every_replica_shedding_is_engine_overloaded():
+    r = ReplicaRouter([FakeEngine("shed"), FakeEngine("shed")])
+    with pytest.raises(EngineOverloaded):
+        r.submit("score", [0, 0, 0, 0])
+    assert r.outstanding == 0          # the failed admit was retired
+    r.drain(timeout_s=5)
+
+
+def test_submit_shed_walks_to_healthy_peer():
+    shed, ok = FakeEngine("shed"), FakeEngine("auto")
+    r = ReplicaRouter([shed, ok])
+    assert r.submit("score", [1, 0, 0, 0]).result(timeout=5) == 1.0
+    states = r.replica_states()
+    assert states[0]["healthy"], "a shed is backpressure, not a failure"
+    r.drain(timeout_s=5)
+
+
+# ---------------------------------------------------------------------------
+# failure handling: reroute, stall, probe re-admission
+# ---------------------------------------------------------------------------
+
+def test_replica_failure_reroutes_zero_lost_futures():
+    bad, good = FakeEngine("manual"), FakeEngine("manual")
+    r = ReplicaRouter([bad, good], affinity_slack=0)
+    # alternate (op, k) groups so both replicas hold work
+    futs = [r.submit("score", [1, 1, 1, 1], k=(i % 2)) for i in range(8)]
+    assert bad.submitted == 4 and good.submitted == 4
+    # replica 0 dies: its oldest future errors, the rest of its work is
+    # drained and rerouted to the healthy peer WITH the original seeds
+    bad.finish(exc=RuntimeError("XLA runtime poisoned"))
+    wait_until(lambda: good.submitted == 8, msg="reroute to healthy peer")
+    good.finish()
+    for i, f in enumerate(futs):
+        assert f.result(timeout=5) == FakeEngine.value([1, 1, 1, 1], i), \
+            "rerouted request must return the ORIGINAL seed's result"
+    states = r.replica_states()
+    assert not states[0]["healthy"] and states[1]["healthy"]
+    assert r.registry.counter("router/replica_failures").value == 1
+    assert r.registry.counter("router/reroutes").value == 4
+    assert r.registry.gauge("router/healthy/r0").value == 0
+    r.drain(timeout_s=5)
+
+
+def test_async_shed_with_no_peer_stays_typed_overloaded():
+    """A shed is 'full, not failed' even when there is nowhere to reroute:
+    the single-replica (or all-peers-excluded) async-shed path must surface
+    the original EngineOverloaded — back off and retry — not a
+    ReplicaUnavailable that reads as fleet-down."""
+    a = FakeEngine("manual")
+    r = ReplicaRouter([a])
+    f = r.submit("score", [0, 0, 0, 0])
+    a.finish(exc=EngineOverloaded("window saturated"))
+    with pytest.raises(EngineOverloaded):
+        f.result(timeout=5)
+    assert r.replica_states()[0]["healthy"]
+    assert r.registry.counter("router/replica_failures").value == 0
+    r.drain(timeout_s=5)
+
+
+def test_async_shed_reroutes_without_marking_dead():
+    a, b = FakeEngine("manual"), FakeEngine("manual")
+    r = ReplicaRouter([a, b], affinity_slack=0)
+    f = r.submit("score", [2, 0, 0, 0])
+    assert a.submitted == 1
+    # an EngineOverloaded delivered via the future (how remote replicas
+    # shed): the replica is full, not failed — retry peers, stay healthy
+    a.finish(exc=EngineOverloaded("window saturated"))
+    wait_until(lambda: b.submitted == 1, msg="shed reroute")
+    b.finish()
+    assert f.result(timeout=5) == 2.0
+    assert r.replica_states()[0]["healthy"]
+    assert r.registry.counter("router/replica_failures").value == 0
+    r.drain(timeout_s=5)
+
+
+def test_request_timeout_is_terminal_no_reroute():
+    a, b = FakeEngine("manual"), FakeEngine("manual")
+    r = ReplicaRouter([a, b], affinity_slack=0)
+    f = r.submit("score", [0, 0, 0, 0])
+    a.finish(exc=RequestTimeout("queue deadline passed"))
+    with pytest.raises(RequestTimeout):
+        f.result(timeout=5)
+    assert b.submitted == 0, "expired requests must not be re-served late"
+    assert r.replica_states()[0]["healthy"]
+    r.drain(timeout_s=5)
+
+
+def test_stall_detection_drains_wedged_replica():
+    clock = FakeClock()
+    wedged, ok = FakeEngine("manual"), FakeEngine("manual")
+    r = ReplicaRouter([wedged, ok], affinity_slack=0, stall_deadline_s=10.0,
+                      clock=clock)
+    f = r.submit("score", [3, 0, 0, 0])
+    assert wedged.submitted == 1
+    clock.t = 5.0
+    assert r.check_stalls() == 0, "within deadline: no drain"
+    clock.t = 10.1
+    assert r.check_stalls() == 1
+    wait_until(lambda: ok.submitted == 1, msg="stall reroute")
+    ok.finish()
+    assert f.result(timeout=5) == 3.0
+    assert not r.replica_states()[0]["healthy"]
+    assert r.registry.counter("router/stall_drains").value == 1
+    r.drain(timeout_s=5)
+
+
+def test_probe_readmission():
+    flaky = FakeEngine("raise")
+    ok = FakeEngine("auto")
+    r = ReplicaRouter([flaky, ok], probe_timeout_s=1.0)
+    # submit-time failure marks r0 unhealthy and lands on r1
+    assert r.submit("score", [1, 0, 0, 0]).result(timeout=5) == 1.0
+    assert not r.replica_states()[0]["healthy"]
+    # while broken, probes fail and it stays out
+    assert r.probe_unhealthy() == 0
+    assert not r.replica_states()[0]["healthy"]
+    # repaired: one successful warm probe re-admits it
+    flaky.mode = "auto"
+    assert r.probe_unhealthy() == 1
+    assert r.replica_states()[0]["healthy"]
+    assert r.registry.counter("router/probe_readmits").value == 1
+    assert r.registry.gauge("router/healthy/r0").value == 1
+    r.drain(timeout_s=5)
+
+
+def test_drain_on_stop_completes_everything():
+    engines = [FakeEngine("manual") for _ in range(2)]
+    r = ReplicaRouter(engines, affinity_slack=0)
+    futs = [r.submit("score", [1, 1, 1, 1], k=(i % 2)) for i in range(6)]
+    # drain: intake closes, engine.stop() flushes held work, all complete
+    r.drain(timeout_s=5)
+    assert all(e.stopped for e in engines)
+    assert all(f.done() for f in futs), "drain lost futures"
+    assert sum(1 for f in futs if f.exception() is None) == 6
+    with pytest.raises(ReplicaUnavailable):
+        r.submit("score", [0, 0, 0, 0])
+    assert r.outstanding == 0
+
+
+def test_drain_error_completes_leftovers():
+    class DeadStop(FakeEngine):
+        def stop(self, timeout_s=None):   # dies holding work: futures leak
+            raise RuntimeError("segfault during drain")
+
+    eng = DeadStop("manual")
+    r = ReplicaRouter([eng])
+    f = r.submit("score", [0, 0, 0, 0])
+    r.drain(timeout_s=1.0)
+    # the engine died without completing it; drain must still answer
+    assert f.done()
+    assert isinstance(f.exception(), ReplicaUnavailable)
+
+
+# ---------------------------------------------------------------------------
+# quota state machine (fake clock)
+# ---------------------------------------------------------------------------
+
+def test_quota_refill_and_reject():
+    clock = FakeClock()
+    q = ClientQuotas(QuotaPolicy(rate=2.0, burst=4.0), clock=clock)
+    q.admit("a", 4)                       # full bucket covers the burst
+    with pytest.raises(QuotaExceeded):
+        q.admit("a", 1)                   # dry
+    assert q.tokens("a") == 0.0           # rejection consumed nothing
+    clock.t = 1.0                         # refill 2 tokens
+    q.admit("a", 2)
+    with pytest.raises(QuotaExceeded):
+        q.admit("a", 1)
+    clock.t = 100.0                       # refill clamps at burst
+    assert q.tokens("a") == 4.0
+    with pytest.raises(QuotaExceeded):
+        q.admit("a", 5)                   # cost > burst can NEVER be admitted
+
+
+def test_quota_per_client_isolation_and_anonymous():
+    clock = FakeClock()
+    q = ClientQuotas(QuotaPolicy(rate=1.0, burst=2.0), clock=clock)
+    q.admit("a", 2)
+    q.admit("b", 2)                       # b's bucket is its own
+    with pytest.raises(QuotaExceeded):
+        q.admit("a", 1)
+    q.admit(None, 2)                      # no client id = shared principal
+    with pytest.raises(QuotaExceeded):
+        q.admit(None, 1)
+    assert q.clients() == ["a", "anonymous", "b"]
+
+
+def test_quota_refund_restores_tokens_clamped_at_burst():
+    clock = FakeClock()
+    q = ClientQuotas(QuotaPolicy(rate=1.0, burst=4.0), clock=clock)
+    q.admit("a", 3)
+    q.refund("a", 3)                      # routing rejected it: full undo
+    assert q.tokens("a") == 4.0
+    q.admit("a", 1)
+    q.refund("a", 100)                    # refund clamps at burst
+    assert q.tokens("a") == 4.0
+    ClientQuotas(None).refund("a", 1)     # disabled quotas: no-op
+
+
+def test_quota_disabled_admits_everything():
+    q = ClientQuotas(None)
+    for _ in range(100):
+        q.admit("anyone", 1e9)
+    assert not q.enabled and q.tokens("anyone") is None
+
+
+# ---------------------------------------------------------------------------
+# wire protocol
+# ---------------------------------------------------------------------------
+
+class ChunkSock:
+    """recv() serving a byte string in fixed-size chunks."""
+
+    def __init__(self, data, chunk=3):
+        self.data = data
+        self.chunk = chunk
+
+    def recv(self, n):
+        out, self.data = self.data[:self.chunk], self.data[self.chunk:]
+        return out
+
+
+def test_line_reader_reassembles_chunks():
+    r = protocol.LineReader(ChunkSock(b'{"a":1}\n{"b":2}\n'))
+    assert json.loads(r.next_line()) == {"a": 1}
+    assert json.loads(r.next_line()) == {"b": 2}
+    assert r.next_line() is None          # clean EOF
+
+
+def test_line_reader_mid_line_eof_and_bound():
+    with pytest.raises(protocol.ProtocolError):
+        protocol.LineReader(ChunkSock(b'{"a":')).next_line()
+    with pytest.raises(protocol.ProtocolError):
+        protocol.LineReader(ChunkSock(b"x" * 100, chunk=50),
+                            max_line_bytes=10).next_line()
+
+
+def test_error_code_taxonomy():
+    assert protocol.error_code_for(QuotaExceeded("x")) == "quota_exceeded"
+    assert protocol.error_code_for(TierOverloaded("x")) == "overloaded"
+    assert protocol.error_code_for(EngineOverloaded("x")) == "overloaded"
+    assert protocol.error_code_for(RequestTimeout("x")) == "timeout"
+    assert protocol.error_code_for(ReplicaUnavailable("x")) == "unavailable"
+    assert protocol.error_code_for(ValueError("x")) == "bad_request"
+    assert protocol.error_code_for(RuntimeError("x")) == "internal"
+    # unknown codes degrade to internal rather than inventing taxonomy
+    assert protocol.error_response(1, "no_such_code", "m")["error"] == \
+        "internal"
+
+
+def test_decode_line_rejects_non_objects():
+    with pytest.raises(protocol.ProtocolError):
+        protocol.decode_line(b"[1, 2]")
+    with pytest.raises(protocol.ProtocolError):
+        protocol.decode_line(b"{nope")
+
+
+# ---------------------------------------------------------------------------
+# the TCP front end (real sockets, fake engines)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fake_tier():
+    engines = [FakeEngine("auto"), FakeEngine("auto")]
+    tier = ServingTier(engines, quota=None, monitor_interval_s=0.05)
+    tier.start()
+    yield tier, engines
+    tier.stop(timeout_s=10)
+
+
+def test_tier_end_to_end_and_out_of_order_ids(fake_tier):
+    tier, _ = fake_tier
+    with TierClient("127.0.0.1", tier.port) as c:
+        # pipelined: several requests in flight, demuxed on echoed id
+        ids = [c.submit("score", [[float(i), 0, 0, 0]]) for i in range(5)]
+        got = c.drain(ids)
+        assert all(got[rid]["ok"] for rid in ids)
+        # seeds mint in tier admission order: i-th request sees seed i
+        assert [got[rid]["result"][0] for rid in ids] == \
+            [i * 1000.0 + float(i) for i in range(5)]
+        info = c.info()
+        assert info["replicas"] == 2 and info["ops"] == \
+            ["decode", "encode", "score"]
+        stats = c.stats()
+        assert stats["router"]["router/routed"] == 5
+        assert len(stats["replicas"]) == 2
+
+
+def test_tier_typed_errors_keep_connection_alive(fake_tier):
+    tier, engines = fake_tier
+    with TierClient("127.0.0.1", tier.port) as c:
+        # malformed JSON -> bad_request, connection survives
+        c._sock.sendall(b"this is not json\n")
+        resp = c._read_one()
+        assert resp["ok"] is False and resp["error"] == "bad_request"
+        # empty payload -> bad_request
+        with pytest.raises(TierError) as ei:
+            c.request("score", [])
+        assert ei.value.code == "bad_request"
+        # multi-row + seed -> bad_request (seed names ONE row's stream)
+        rid = c.submit("score", [[0, 0, 0, 0], [1, 1, 1, 1]], seed=3)
+        assert c.drain([rid])[rid]["error"] == "bad_request"
+        # out-of-int32-range seed dies at the wire as THIS client's
+        # bad_request — inside a replica it would error a whole coalesced
+        # batch and cascade as a replica failure across the fleet
+        for bad_seed in (-1, 2 ** 31):
+            rid = c.submit("score", [[0, 0, 0, 0]], seed=bad_seed)
+            assert c.drain([rid])[rid]["error"] == "bad_request"
+        assert all(rep["healthy"] for rep in tier.stats()["replicas"])
+        # every replica shedding -> overloaded, typed
+        for e in engines:
+            e.mode = "shed"
+        with pytest.raises(TierError) as ei:
+            c.score([[0, 0, 0, 0]])
+        assert ei.value.code == "overloaded"
+        for e in engines:
+            e.mode = "auto"
+        # and the SAME connection still serves
+        assert c.score([[1, 0, 0, 0]])
+
+
+def test_tier_quota_rejection_is_typed_response():
+    engines = [FakeEngine("auto")]
+    tier = ServingTier(engines, quota=QuotaPolicy(rate=0.001, burst=2))
+    tier.start()
+    try:
+        with TierClient("127.0.0.1", tier.port, client_id="t1") as c:
+            assert c.score([[0, 0, 0, 0], [0, 0, 0, 0]])   # burst covers 2
+            with pytest.raises(TierError) as ei:
+                c.score([[0, 0, 0, 0]])                    # dry
+            assert ei.value.code == "quota_exceeded"
+            # another client's bucket is untouched
+            with TierClient("127.0.0.1", tier.port, client_id="t2") as c2:
+                assert c2.score([[0, 0, 0, 0]])
+        assert tier.registry.counter(
+            "router/quota_rejections").value == 1
+    finally:
+        tier.stop(timeout_s=10)
+
+
+def test_quota_refunded_when_routing_rejects():
+    """The quota meters SERVED work: a request admitted past the token
+    bucket but rejected by the fleet (every replica shedding) gets its
+    tokens back — sustained overload must surface as 'overloaded', never
+    stack 'quota_exceeded' on top of it."""
+    eng = FakeEngine("shed")
+    tier = ServingTier([eng], quota=QuotaPolicy(rate=0.001, burst=2))
+    tier.start()
+    try:
+        with TierClient("127.0.0.1", tier.port, client_id="t1") as c:
+            for _ in range(4):       # 4 rejects > burst 2: only refunds
+                with pytest.raises(TierError) as ei:
+                    c.score([[0, 0, 0, 0]])
+                assert ei.value.code == "overloaded"
+            eng.mode = "auto"        # capacity restored: tokens were kept
+            assert c.score([[1, 0, 0, 0]])
+        # burst 2 - 1 served (real clock: the 1e-3/s refill drifts a hair)
+        assert tier.quotas.tokens("t1") == pytest.approx(1.0, abs=0.01)
+    finally:
+        tier.stop(timeout_s=10)
+
+
+def test_tier_mid_burst_replica_kill_loses_nothing():
+    """The acceptance pin: a replica killed mid-burst loses zero responses —
+    every accepted request gets a result (rerouted) or a typed error."""
+    bad, good = FakeEngine("manual"), FakeEngine("manual")
+    tier = ServingTier([bad, good], monitor_interval_s=0.05)
+    tier.start()
+    try:
+        with TierClient("127.0.0.1", tier.port) as c:
+            ids = [c.submit("score", [[float(i), 0, 0, 0]], k=(i % 2))
+                   for i in range(12)]
+            # wait for the burst to spread over both replicas, then kill one
+            wait_until(lambda: bad.submitted + good.submitted == 12,
+                       msg="burst fully routed")
+            assert bad.submitted and good.submitted
+            bad.finish(exc=RuntimeError("replica killed mid-burst"))
+            # complete everything the healthy replica now holds (original
+            # work + rerouted work); keep finishing until the wire drains
+            done = {}
+            t = threading.Thread(
+                target=lambda: done.update(c.drain(ids)), daemon=True)
+            t.start()
+            deadline = time.monotonic() + 10
+            while t.is_alive() and time.monotonic() < deadline:
+                good.finish()
+                time.sleep(0.01)
+            t.join(timeout=1)
+            assert not t.is_alive(), "burst responses never drained"
+            assert len(done) == 12
+            for i, rid in enumerate(ids):
+                assert done[rid]["ok"], done[rid]
+                # rerouted rows carry their ORIGINAL seed: result is the
+                # same value the dead replica would have returned
+                assert done[rid]["result"][0] == i * 1000.0 + float(i)
+        st = tier.stats()
+        assert st["router"]["router/reroutes"] >= 1
+        assert st["router"]["router/replica_failures"] == 1
+        healthy = [r["healthy"] for r in st["replicas"]]
+        assert healthy.count(False) == 1
+    finally:
+        tier.stop(timeout_s=10)
+
+
+def test_tier_stop_answers_pending_requests():
+    eng = FakeEngine("manual")
+    tier = ServingTier([eng], monitor_interval_s=0.05)
+    tier.start()
+    c = TierClient("127.0.0.1", tier.port)
+    try:
+        ids = [c.submit("score", [[1, 0, 0, 0]]) for _ in range(4)]
+        wait_until(lambda: eng.submitted == 4, msg="requests routed")
+        # graceful drain: engine.stop() (the fake completes its held work),
+        # responses flushed BEFORE sockets close
+        stopper = threading.Thread(target=tier.stop, daemon=True)
+        stopper.start()
+        got = c.drain(ids)
+        stopper.join(timeout=10)
+        assert not stopper.is_alive()
+        assert len(got) == 4 and all(got[rid]["ok"] for rid in ids)
+    finally:
+        c.close()
+        tier.stop(timeout_s=5)
+
+
+def test_prometheus_router_schema(fake_tier):
+    """Router metrics are visible on the exporter page with stable names."""
+    from iwae_replication_project_tpu.telemetry import prometheus_text
+
+    tier, _ = fake_tier
+    with TierClient("127.0.0.1", tier.port) as c:
+        c.score([[1, 0, 0, 0]])
+    page = prometheus_text(tier.registry)
+    for counter in ("routed", "completed", "errors", "reroutes", "sheds",
+                    "quota_rejections", "replica_failures", "affinity_hits",
+                    "stall_drains", "probe_readmits"):
+        assert f"iwae_router_{counter}_total" in page, counter
+    for gauge in ("iwae_router_outstanding", "iwae_router_replicas",
+                  "iwae_router_inflight_r0", "iwae_router_inflight_r1",
+                  "iwae_router_healthy_r0", "iwae_router_healthy_r1"):
+        assert f"# TYPE {gauge} gauge" in page, gauge
+    assert "iwae_router_routed_total 1" in page
+
+
+# ---------------------------------------------------------------------------
+# RemoteEngine: fleet composition over processes
+# ---------------------------------------------------------------------------
+
+def test_remote_engine_engine_surface(fake_tier):
+    tier, _ = fake_tier
+    with RemoteEngine("127.0.0.1", tier.port) as rem:
+        assert rem.row_dims == {"score": 4, "encode": 4, "decode": 4}
+        assert rem.k == 5
+        # explicit seed rides through to the leaf engine bitwise
+        assert rem.submit("score", [2.0, 0, 0, 0],
+                          seed=9).result(timeout=5) == 9002.0
+        with pytest.raises(ValueError):
+            rem.submit("nope", [0, 0, 0, 0])
+        with pytest.raises(ValueError):
+            rem.submit("score", [0, 0])      # wrong feature count
+        with pytest.raises(ValueError):
+            rem.submit("score", [0, 0, 0, 0], seed=2 ** 31)  # int32 bound
+
+
+def test_remote_engine_connection_loss_fails_outstanding():
+    eng = FakeEngine("manual")
+    tier = ServingTier([eng], monitor_interval_s=0.05)
+    tier.start()
+    rem = RemoteEngine("127.0.0.1", tier.port)
+    f = rem.submit("score", [0, 0, 0, 0], seed=1)
+    wait_until(lambda: eng.submitted == 1, msg="request routed")
+    # the tier dies under the proxy: the graceful drain answers the held
+    # request first, then the closed connection poisons the proxy — the
+    # future must RESOLVE either way (result, or the typed unavailable)
+    tier.stop(timeout_s=5)
+    wait_until(f.done, msg="future resolution on connection loss")
+    if f.exception() is None:
+        assert f.result() == 1000.0
+    else:
+        assert isinstance(f.exception(), ReplicaUnavailable)
+    wait_until(lambda: rem._dead is not None, msg="proxy poisoning")
+    with pytest.raises(ReplicaUnavailable):
+        rem.submit("score", [0, 0, 0, 0])
+    rem.close()
+
+
+def test_parent_router_over_remote_tiers():
+    """Fleet-of-fleets: a parent router over two RemoteEngine proxies, each
+    fronting its own child tier; a child tier killed mid-flight has its work
+    rerouted to the surviving child with the parent's original seeds."""
+    child_a = ServingTier([FakeEngine("auto")], monitor_interval_s=0.05)
+    child_b = ServingTier([FakeEngine("auto")], monitor_interval_s=0.05)
+    child_a.start(), child_b.start()
+    try:
+        rem_a = RemoteEngine("127.0.0.1", child_a.port)
+        rem_b = RemoteEngine("127.0.0.1", child_b.port)
+        parent = ReplicaRouter([rem_a, rem_b], affinity_slack=0)
+        got = [parent.submit("score", [1.0, 0, 0, 0], k=(i % 2))
+               .result(timeout=5) for i in range(6)]
+        # parent-minted seeds (admission order) determine results, NOT which
+        # child served: bitwise independent of process placement
+        assert got == [i * 1000.0 + 1.0 for i in range(6)]
+        parent.drain(timeout_s=5)
+    finally:
+        child_a.stop(timeout_s=5), child_b.stop(timeout_s=5)
+
+
+# ---------------------------------------------------------------------------
+# real-engine integration: fleet parity + zero recompiles (the AOT pin)
+# ---------------------------------------------------------------------------
+
+D = 32
+TINY = dict(n_hidden_enc=(16, 8), n_latent_enc=(8, 4),
+            n_hidden_dec=(8, 16), n_latent_dec=(8, D))
+
+
+@pytest.fixture(scope="module")
+def tiny_fleet():
+    import jax
+
+    from iwae_replication_project_tpu.models import iwae as model
+    from iwae_replication_project_tpu.serving import ServingEngine
+
+    cfg = model.ModelConfig(x_dim=D, **TINY)
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+
+    def engine():
+        return ServingEngine(params=params, model_config=cfg, k=4,
+                             max_batch=8, timeout_s=30.0)
+
+    x = (np.random.RandomState(1).rand(40, D) > 0.5).astype(np.float32)
+    return {"engine": engine, "x": x}
+
+
+def test_fleet_bitwise_parity_with_direct_engine(tiny_fleet):
+    """The tentpole semantic pin: a 2-replica tier over TCP returns results
+    bitwise identical to ONE direct in-process engine fed the same rows in
+    the same order — routing, padding, and the wire are all invisible."""
+    x = tiny_fleet["x"][:17]
+    direct = tiny_fleet["engine"]()
+    ref = direct.score(x)          # seeds 0..16 in submit order
+    direct.stop()
+
+    tier = ServingTier([tiny_fleet["engine"](), tiny_fleet["engine"]()],
+                       monitor_interval_s=0.05)
+    tier.warmup(ops=("score",))
+    tier.start()
+    try:
+        with TierClient("127.0.0.1", tier.port) as c:
+            # ragged multi-row requests; tier admission order = row order
+            got, i = [], 0
+            for n in (1, 3, 7, 2, 4):
+                got.extend(c.score(x[i:i + n].tolist()))
+                i += n
+        wire = np.asarray(got, dtype=ref.dtype)
+        assert np.array_equal(wire, ref), \
+            "fleet results differ from the direct single-engine run"
+    finally:
+        tier.stop(timeout_s=10)
+
+
+def test_multi_client_ragged_stream_zero_recompiles(tiny_fleet):
+    """The satellite bugfix pin: client identity (client id, quota state)
+    must never reach an AOT program signature — a warmed tier serving a
+    ragged MULTI-client stream compiles nothing and adds no registry
+    entries, and the traced-program goldens (tests/test_audit.py) stay
+    unchanged because the serving programs never see a client field."""
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        cache_stats, registry_signatures, stats_delta)
+
+    tier = ServingTier([tiny_fleet["engine"](), tiny_fleet["engine"]()],
+                       quota=QuotaPolicy(rate=1e6, burst=1e6),
+                       monitor_interval_s=0.05)
+    tier.warmup(ops=("score", "encode"))
+    tier.start()
+    try:
+        sigs0 = set(map(str, registry_signatures()))
+        s0 = cache_stats()
+        x = tiny_fleet["x"]
+        clients = ("tenant-a", "tenant-b", None, "tenant-c")
+        conns = [TierClient("127.0.0.1", tier.port, client_id=cid)
+                 for cid in clients]
+        try:
+            ids = []
+            for j, c in enumerate(conns):
+                n = (1, 3, 7, 5)[j % 4]
+                ids.append((c, c.submit("score", x[:n].tolist())))
+                ids.append((c, c.submit("encode", x[:n + 1].tolist())))
+            for c, rid in ids:
+                resp = c.drain([rid])[rid]
+                assert resp["ok"], resp
+        finally:
+            for c in conns:
+                c.close()
+        d = stats_delta(s0)
+        assert d["aot_misses"] == 0, \
+            f"multi-client stream caused AOT compiles: {d}"
+        assert d["persistent_cache_misses"] == 0, d
+        assert set(map(str, registry_signatures())) == sigs0, \
+            "client identity leaked into AOT program signatures"
+    finally:
+        tier.stop(timeout_s=10)
