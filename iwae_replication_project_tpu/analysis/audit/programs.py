@@ -30,6 +30,7 @@ PROGRAM_NAMES = (
     "serve_score",
     "serve_encode",
     "serve_decode",
+    "serve_score_sharded",
     "hot_loop_reference",
     "hot_loop_blocked_scan",
     "hot_loop_pallas",
@@ -153,6 +154,45 @@ def build_serving(op: str) -> AuditProgram:
                    tuple(sorted(kwargs.items(), key=lambda kv: kv[0]))), {}))
 
 
+def build_serving_sharded() -> AuditProgram:
+    """The mesh-sharded dynamic-k score program (ShardedScoreEngine's
+    dispatch) at a padded bucket: bucket 8 holding 5 real rows on a 1x1
+    mesh, k=10 over k_chunk=4 blocks — so the traced program carries the
+    dynamic fori_loop (ragged final block masked in-graph) AND both
+    declared padded axes, exactly the dataflow the taint pass must prove
+    clean through the shard_map + while-loop carry."""
+    import jax
+    import jax.numpy as jnp
+
+    from iwae_replication_project_tpu.parallel.mesh import make_mesh
+    from iwae_replication_project_tpu.serving.programs import (
+        PADDED_ROW_KWARGS,
+        make_sharded_score_rows,
+    )
+
+    cfg, state = _model_state()
+    cfg = dataclasses.replace(cfg, fused_likelihood=False)  # the engine's pin
+    mesh = make_mesh(dp=1, sp=1, devices=jax.devices()[:1])
+    program = make_sharded_score_rows(cfg, mesh, k_chunk=4)
+    bucket, real = 8, 5
+    base_key = jax.random.PRNGKey(5)
+    seeds = jnp.zeros((bucket,), jnp.int32)
+    payload = jnp.zeros((bucket, cfg.x_dim), jnp.float32)
+    k_arr = jnp.int32(10)
+
+    def fn(params, base_key, seeds, payload, k_arr):
+        return program(params, base_key, seeds, payload, k_arr)
+
+    args = (state.params, base_key, seeds, payload, k_arr)
+    kwargs = {"seeds": seeds, "x": payload}
+    tainted = [kwargs[name] for name in PADDED_ROW_KWARGS["score_sharded"]]
+    return AuditProgram(
+        name="serve_score_sharded",
+        jaxpr=jax.make_jaxpr(fn)(*args),
+        taints=_taint_indices(args, tainted, {0: real}),
+        sig_args=((state.params, base_key, seeds, payload, k_arr), {}))
+
+
 def build_hot_loop(path: str) -> AuditProgram:
     """One hot-loop path composed with the estimator reduction it feeds
     (``iwae_per_example``'s logsumexp over k) — the padded-tile dataflow
@@ -193,6 +233,7 @@ def build_programs(include: Optional[Sequence[str]] = None
         "serve_score": lambda: build_serving("score"),
         "serve_encode": lambda: build_serving("encode"),
         "serve_decode": lambda: build_serving("decode"),
+        "serve_score_sharded": build_serving_sharded,
         "hot_loop_reference": lambda: build_hot_loop("reference"),
         "hot_loop_blocked_scan": lambda: build_hot_loop("blocked_scan"),
         "hot_loop_pallas": lambda: build_hot_loop("pallas"),

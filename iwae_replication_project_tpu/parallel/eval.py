@@ -78,6 +78,78 @@ def _local_streaming_log_px(params, cfg, key, x_local, k_local: int,
     return jnp.log(s_g) + safe - jnp.log(float(k_global))
 
 
+def _local_row_streaming_log_px(params, cfg, base_key, seeds_local, x_local,
+                                k_dyn, k_chunk: int, n_sp: int):
+    """Per-device body of the *serving-grade* sharded scorer: ``[B_local]``
+    partial log p̂(x) with per-ROW RNG and a *dynamic* k.
+
+    The per-batch sibling above (:func:`_local_streaming_log_px`) fans one
+    key into the whole ``[chunk, B]`` tensor — fine offline, fatal for a
+    micro-batching engine (a row's value would depend on its batch peers).
+    Here each row's sample block ``g`` draws from
+    ``fold_in(fold_in(base_key, seed_row), g)`` where ``g`` is the *global*
+    block index — so the sampled weights are bitwise independent of batch
+    coalescing, of how many blocks a dispatch spans, and of which sp device
+    streams which blocks. ``k_dyn`` is a traced int32 scalar: the loop runs
+    ``ceil(ceil(k/k_chunk)/sp)`` blocks per device (a dynamic
+    ``fori_loop``), and samples at global index >= k — the ragged final
+    block, and whole blocks on idle devices when sp does not divide the
+    block count — are masked to ``-inf`` (an exact zero contribution to the
+    online carry). Callers finish with :func:`_merge_lse_over_sp` and
+    normalize by ``log k``.
+    """
+    sp_idx = lax.axis_index(AXES.sp)
+    n_blocks = lax.div(k_dyn + (k_chunk - 1), k_chunk)
+    blocks_per_dev = lax.div(n_blocks + (n_sp - 1), n_sp)
+
+    def row_block(seed, xr, g):
+        key = jax.random.fold_in(jax.random.fold_in(base_key, seed), g)
+        return model.log_weights(params, cfg, key, xr[None], k_chunk)[:, 0]
+
+    def body(i, state):
+        g = sp_idx * blocks_per_dev + i
+        lw = jax.vmap(lambda s, xr: row_block(s, xr, g))(
+            seeds_local, x_local)                        # [B_local, k_chunk]
+        sample_idx = g * k_chunk + jnp.arange(k_chunk)
+        lw = jnp.where(sample_idx[None, :] < k_dyn, lw, -jnp.inf)
+        return online_logsumexp_update(state, lw, axis=1)
+
+    init = online_logsumexp_init((x_local.shape[0],))
+    return lax.fori_loop(0, blocks_per_dev, body, init)
+
+
+def sharded_score_offline(params, cfg, mesh, base_key, seeds, x, k: int,
+                          k_chunk: int = 250):
+    """Offline entry to THE sharded serving score program: ``[B]`` per-row
+    log p̂(x) with batch over dp, k blocks over sp.
+
+    This calls the exact jitted program the mesh-backed serving engine
+    dispatches (serving/programs.make_sharded_score_rows), so an offline
+    paper-grade NLL sweep and an online ``score`` request at the same
+    (mesh, k_chunk, seed) are bitwise identical *by construction* — the
+    parity pin bench.py --large-k and scripts/large_k_smoke.py assert.
+
+    A batch not divisible by dp is zero-padded up to the next dp multiple
+    and sliced after — exactly the serving engine's bucket move, and
+    exactly as invisible: per-row RNG makes every real row's value
+    independent of the padding rows around it.
+    """
+    from iwae_replication_project_tpu.serving.programs import (
+        make_sharded_score_rows)
+
+    seeds = jnp.asarray(seeds, jnp.int32)
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[0]
+    n_dp = mesh.shape[AXES.dp]
+    pad = (-n) % n_dp
+    if pad:
+        seeds = jnp.pad(seeds, (0, pad))
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    fn = make_sharded_score_rows(cfg, mesh, k_chunk)
+    out = fn(params, base_key, seeds, x, jnp.int32(k))
+    return out[:n]
+
+
 def _local_batch_metrics(params, cfg, key, x_local, k_local: int,
                          k_global: int):
     """Single-pass metric bundle on the local shard; scalars are means over

@@ -17,9 +17,14 @@ from iwae_replication_project_tpu.serving.batcher import (
     Request,
     RequestTimeout,
 )
-from iwae_replication_project_tpu.serving.buckets import BucketLadder
+from iwae_replication_project_tpu.serving.buckets import (
+    BucketLadder,
+    KChunkMenu,
+)
 from iwae_replication_project_tpu.serving.engine import ServingEngine
 from iwae_replication_project_tpu.serving.metrics import ServingMetrics
+from iwae_replication_project_tpu.serving.sharded import ShardedScoreEngine
 
-__all__ = ["ServingEngine", "BucketLadder", "MicroBatcher", "Request",
-           "ServingMetrics", "EngineOverloaded", "RequestTimeout"]
+__all__ = ["ServingEngine", "ShardedScoreEngine", "BucketLadder",
+           "KChunkMenu", "MicroBatcher", "Request", "ServingMetrics",
+           "EngineOverloaded", "RequestTimeout"]

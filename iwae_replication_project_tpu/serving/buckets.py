@@ -109,3 +109,58 @@ class BucketLadder:
         out = np.zeros((bucket,) + rows.shape[1:], dtype=rows.dtype)
         out[:n] = rows
         return out
+
+
+@dataclasses.dataclass(frozen=True)
+class KChunkMenu:
+    """The 2-D ``(batch_bucket, k)`` menu of the sharded large-k score path.
+
+    The batch axis keeps the 1-D :class:`BucketLadder` quantization (one
+    executable per rung). The k axis needs no quantization at all: the
+    sharded score program (serving/programs.make_sharded_score_rows) takes
+    ``k`` as a *dynamic* scalar input and streams it in fixed ``k_chunk``
+    sample blocks — RNG is keyed per (request seed, global block index), and
+    a ragged final block is masked to ``-inf`` — so ONE executable per batch
+    bucket serves every ``k`` in ``[1, k_max]`` with zero recompiles.
+    ``k_chunk`` is therefore a *sampling-contract* constant (it versions the
+    RNG stream and the per-step working-set size), and ``k_max`` is the
+    admission bound that turns an absurd ask into a typed ``bad_request``
+    instead of an unbounded device occupation.
+    """
+
+    batch: BucketLadder
+    k_chunk: int = 250
+    k_max: int = 5000
+
+    def __post_init__(self):
+        if self.k_chunk < 1:
+            raise ValueError(f"k_chunk must be >= 1, got {self.k_chunk}")
+        if self.k_max < 1:
+            raise ValueError(f"k_max must be >= 1, got {self.k_max}")
+
+    def validate_k(self, k) -> int:
+        """`k` as a validated int, or ValueError (the typed ``bad_request``
+        for every serving boundary — engine submit, router, protocol)."""
+        return validate_k(k, self.k_max)
+
+    def n_chunks(self, k: int) -> int:
+        """Sample blocks a k-request spans (the final one may be ragged)."""
+        return -(-self.validate_k(k) // self.k_chunk)
+
+
+def validate_k(k, k_max: int) -> int:
+    """Shared out-of-range-k check: an int in ``[1, k_max]`` or ValueError.
+
+    One implementation for every admission boundary so the engine, the
+    replica router, and the wire protocol cannot drift on what "bad k"
+    means — an out-of-range k must surface as a typed ``bad_request`` at
+    the first boundary it crosses, never as an internal error or a silent
+    giant compile inside a replica.
+    """
+    if isinstance(k, bool) or not isinstance(k, (int, np.integer)):
+        raise ValueError(f"k must be an integer, got {type(k).__name__}")
+    k = int(k)
+    if not 1 <= k <= k_max:
+        raise ValueError(f"k={k} is out of range [1, {k_max}] for this "
+                         f"serving path")
+    return k

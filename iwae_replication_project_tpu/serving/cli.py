@@ -100,6 +100,35 @@ def build_argparser() -> argparse.ArgumentParser:
                       default=4096,
                       help="tier-wide admission ceiling (outstanding "
                            "requests) before typed 'overloaded' rejections")
+    tier.add_argument("--sharded-replicas", dest="sharded_replicas",
+                      type=int, default=0,
+                      help="additionally run N mesh-backed large-k score "
+                           "replicas (ShardedScoreEngine over the same "
+                           "weights): the router sends score requests "
+                           "above the k threshold to them, small-k "
+                           "traffic keeps the fast single-device path")
+    tier.add_argument("--k-chunk", dest="k_chunk", type=int, default=250,
+                      help="sharded path: canonical sample-block size (it "
+                           "versions the RNG stream; k streams over the "
+                           "mesh sp axis in blocks of this size)")
+    tier.add_argument("--k-max", dest="k_max", type=int, default=5000,
+                      help="sharded path: per-request k admission bound "
+                           "(typed bad_request past it)")
+    tier.add_argument("--k-threshold", dest="k_threshold", type=int,
+                      default=None,
+                      help="route score requests with k above this to the "
+                           "sharded replicas; it also becomes the fast "
+                           "replicas' k_max, so the two classes tile "
+                           "[1, --k-max] exactly (default: the engine "
+                           "default bound, or --k-max/2 when --k-max is "
+                           "at or below it)")
+    tier.add_argument("--mesh-dp", dest="mesh_dp", type=int, default=1,
+                      help="sharded replicas: data-parallel mesh axis "
+                           "(batch rows shard over it)")
+    tier.add_argument("--mesh-sp", dest="mesh_sp", type=int, default=None,
+                      help="sharded replicas: sample-parallel mesh axis "
+                           "(k blocks stream over it; default: all "
+                           "remaining devices)")
     tier.add_argument("--quota-rate", dest="quota_rate", type=float,
                       default=None,
                       help="per-client token-bucket refill (rows/sec); "
@@ -116,6 +145,12 @@ def build_argparser() -> argparse.ArgumentParser:
                       default=None,
                       help="client mode: the quota principal stamped on "
                            "requests")
+    tier.add_argument("--k-sweep", dest="k_sweep", type=str, default=None,
+                      metavar="K1,K2,...",
+                      help="client mode: score-only load that cycles "
+                           "per-request k through these values (e.g. "
+                           "'50,500,5000') — the closed-loop driver for "
+                           "the large-k path; reports per-k latency")
     ap.add_argument("--interactive", action="store_true",
                     help="serve JSON-lines requests from stdin instead of "
                          "synthetic load")
@@ -166,19 +201,68 @@ def _build_engine(args):
     return zoo.serving_engine(ecfg, k=args.k, **_engine_knobs(args))
 
 
+def _k_split(args):
+    """The mixed tier's (fast k_max, routing threshold): the two classes
+    must tile ``[1, --k-max]`` — fast serves up to the threshold, sharded
+    takes the rest — or the sharded replicas would be unreachable. With no
+    explicit ``--k-threshold`` the split sits at the engine default bound
+    (DEFAULT_K_MAX), falling back to half of ``--k-max`` when the whole
+    range fits under it."""
+    from iwae_replication_project_tpu.serving.engine import DEFAULT_K_MAX
+
+    if args.sharded_replicas <= 0:
+        return None, args.k_threshold
+    t = args.k_threshold
+    if t is None:
+        t = DEFAULT_K_MAX if DEFAULT_K_MAX < args.k_max \
+            else max(1, args.k_max // 2)
+    if not 1 <= t < args.k_max:
+        # threshold at/above k_max would make the sharded replicas
+        # unreachable while claiming to serve large k — refuse loudly
+        raise SystemExit(f"--k-threshold {t} must be in [1, --k-max "
+                         f"{args.k_max}) when --sharded-replicas is set")
+    return t, t
+
+
 def _build_replicas(args, n: int):
-    """N engines over ONE set of weights (replica fleet construction):
-    the first engine resolves the checkpoint/preset, the rest share its
-    params and config — process-local replicas, exactly what the tier
-    composes on a multi-device host with one engine per device."""
+    """N fast engines (+ any ``--sharded-replicas`` mesh engines) over ONE
+    set of weights: the first engine resolves the checkpoint/preset, the
+    rest share its params and config — process-local replicas, exactly
+    what the tier composes on a multi-device host with one engine (or one
+    mesh slice) per replica."""
     from iwae_replication_project_tpu.serving.engine import ServingEngine
 
+    fast_k_max, _ = _k_split(args)
     first = _build_engine(args)
+    if fast_k_max is not None:
+        # the fast bound IS the threshold (raised as well as capped, so an
+        # explicit --k-threshold above the engine default leaves no k with
+        # zero eligible replicas), but never below the engine's own
+        # default k (a checkpoint trained above the split must still
+        # serve its default requests)
+        first.k_max = max(fast_k_max, first.k)
     engines = [first]
     for _ in range(1, n):
         engines.append(ServingEngine(
             params=first._params, model_config=first.cfg, k=first.k,
-            **_engine_knobs(args)))
+            k_max=first.k_max, **_engine_knobs(args)))
+    if args.sharded_replicas > 0:
+        import jax
+
+        from iwae_replication_project_tpu.parallel.mesh import make_mesh
+        from iwae_replication_project_tpu.serving.sharded import (
+            ShardedScoreEngine)
+        sp = args.mesh_sp if args.mesh_sp is not None \
+            else max(1, jax.device_count() // args.mesh_dp)
+        mesh = make_mesh(dp=args.mesh_dp, sp=sp)
+        knobs = _engine_knobs(args)
+        knobs.pop("ladder", None)   # the sharded ladder must be dp-aligned;
+        knobs.pop("max_batch", None)  # let the engine derive it
+        for _ in range(args.sharded_replicas):
+            engines.append(ShardedScoreEngine(
+                params=first._params, model_config=first.cfg, k=first.k,
+                mesh=mesh, k_chunk=args.k_chunk, k_max=args.k_max,
+                max_batch=args.max_batch, **knobs))
     return engines
 
 
@@ -193,9 +277,11 @@ def _tier_mode(args, ops) -> int:
                             burst=(args.quota_burst
                                    if args.quota_burst is not None
                                    else 10.0 * args.quota_rate))
+    _, threshold = _k_split(args)
     tier = ServingTier(_build_replicas(args, args.replicas), quota=quota,
                        max_outstanding=args.max_outstanding,
-                       host=args.host, port=args.port)
+                       host=args.host, port=args.port,
+                       large_k_threshold=threshold)
     warm = tier.warmup(ops=ops)
     tier.start()
     metrics_srv = None
@@ -207,12 +293,16 @@ def _tier_mode(args, ops) -> int:
         # would collide across replicas on one exposition page)
         metrics_srv = start_metrics_server(
             (get_registry(), tier.registry), args.metrics_port)
+    info = tier.info()
     print(json.dumps({
-        "tier": {"replicas": args.replicas, "port": tier.port,
+        "tier": {"replicas": args.replicas,
+                 "sharded_replicas": info["sharded_replicas"],
+                 "large_k_threshold": info["large_k_threshold"],
+                 "k_max": info["k_max"], "port": tier.port,
                  "host": args.host,
-                 "quota": tier.info()["quota"]},
+                 "quota": info["quota"]},
         "warmup": warm,
-        "buckets": tier.info()["buckets"], "k": tier.info()["k"],
+        "buckets": info["buckets"], "k": info["k"],
         "metrics_port": (metrics_srv.server_address[1]
                          if metrics_srv else None)}), flush=True)
     try:
@@ -251,6 +341,59 @@ def _client_interactive(cli) -> None:
                   flush=True)
 
 
+def _client_k_sweep(cli, args) -> int:
+    """``--client ... --k-sweep K1,K2,...``: closed-loop score load that
+    cycles per-request k — the synthetic driver for the large-k sharded
+    path over TCP. Blocking one-at-a-time requests so each k value gets an
+    honest per-request latency sample; errors (e.g. a k above the tier's
+    k_max, probing the typed bad_request path) are counted, not fatal."""
+    import numpy as np
+
+    from iwae_replication_project_tpu.serving.frontend.client import (
+        TierError)
+
+    info = cli.info()
+    if "score" not in info["row_dims"]:
+        print(json.dumps({"error": "tier does not serve 'score'"}),
+              file=sys.stderr, flush=True)
+        cli.close()
+        return 2
+    ks = [int(s) for s in args.k_sweep.split(",") if s]
+    dim = info["row_dims"]["score"]
+    rng = np.random.RandomState(args.seed)
+    sizes = [int(s) for s in args.sizes.split(",") if s]
+    walls: dict = {k: [] for k in ks}
+    errors: dict = {}
+    rows_ok = 0
+    t0 = time.perf_counter()
+    for i in range(args.requests):
+        k = ks[i % len(ks)]
+        n = sizes[i % len(sizes)]
+        batch = (rng.rand(n, dim) > 0.5).astype(np.float32)
+        t1 = time.perf_counter()
+        try:
+            out = cli.score(batch.tolist(), k=k)
+            rows_ok += len(out)
+            walls[k].append(time.perf_counter() - t1)
+        except TierError as e:
+            errors[e.code] = errors.get(e.code, 0) + 1
+    wall = time.perf_counter() - t0
+    cli.close()
+    per_k = {
+        str(k): {"requests": len(w),
+                 "p50_s": round(float(np.percentile(w, 50)), 6) if w else None,
+                 "p95_s": round(float(np.percentile(w, 95)), 6) if w else None}
+        for k, w in walls.items()}
+    print(json.dumps({"mode": "client-k-sweep", "target": args.client,
+                      "k_sweep": ks, "per_k": per_k, "ok_rows": rows_ok,
+                      "errors": errors, "wall_seconds": round(wall, 3),
+                      "info": {key: info[key] for key in
+                               ("large_k_threshold", "k_max",
+                                "sharded_replicas", "replicas")}}),
+          flush=True)
+    return 0
+
+
 def _client_mode(args) -> int:
     """``--client HOST:PORT``: drive a running tier over TCP."""
     import numpy as np
@@ -264,6 +407,8 @@ def _client_mode(args) -> int:
         _client_interactive(cli)
         cli.close()
         return 0
+    if args.k_sweep:
+        return _client_k_sweep(cli, args)
     info = cli.info()
     ops = [s for s in args.ops.split(",") if s and s in info["row_dims"]]
     if not ops:

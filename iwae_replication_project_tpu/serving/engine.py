@@ -62,11 +62,21 @@ from iwae_replication_project_tpu.serving.buckets import (
     BucketLadder,
     as_row,
     as_rows,
+    validate_k,
 )
 from iwae_replication_project_tpu.serving.metrics import ServingMetrics
 from iwae_replication_project_tpu.serving.programs import PROGRAMS
 
 __all__ = ["ServingEngine", "EngineOverloaded", "RequestTimeout"]
+
+#: default per-request k admission bound for single-device engines. A k
+#: above it is a typed ``bad_request`` (ValueError) at submit — NOT a
+#: silent compile of an arbitrarily large program: the single-device
+#: score/encode programs bake k in statically, so an unbounded client k
+#: is an unbounded compile + device occupation. Paper-grade k (5000)
+#: belongs to the mesh-backed sharded path (serving/sharded.py), whose
+#: menu carries its own k_max.
+DEFAULT_K_MAX = 1024
 
 
 @dataclasses.dataclass
@@ -89,7 +99,10 @@ class ServingEngine:
     ``model_config=`` directly (what the facade's ``serving_engine()`` does).
 
     Knobs: ``k`` (default importance samples per score/encode request;
-    ``None`` = the checkpoint's stored training k, else 50),
+    ``None`` = the checkpoint's stored training k, else 50), ``k_max``
+    (per-request k admission bound — past it ``submit`` raises the typed
+    ValueError/``bad_request``, never a silent compile of an arbitrarily
+    large static-k program; default ``max(DEFAULT_K_MAX, k)``),
     ``max_batch``/``max_wait_us`` (coalescing policy), ``queue_limit``
     (backpressure bound), ``max_inflight`` (dispatched-but-uncompleted batch
     window for the two-stage pipeline; ``0`` = serial dispatch, the
@@ -99,7 +112,8 @@ class ServingEngine:
     """
 
     def __init__(self, source=None, *, params=None, model_config=None,
-                 k: Optional[int] = None, max_batch: int = 64,
+                 k: Optional[int] = None, k_max: Optional[int] = None,
+                 max_batch: int = 64,
                  max_wait_us: float = 2000.0,
                  queue_limit: int = 1024, max_inflight: int = 2,
                  timeout_s: Optional[float] = 2.0,
@@ -130,6 +144,21 @@ class ServingEngine:
         # ROADMAP item 4 follow-ups.
         self.cfg = dataclasses.replace(model_config, fused_likelihood=False)
         self.k = int(k) if k is not None else 50
+        # the engine's k admission bound (typed bad_request past it); the
+        # default never rejects the engine's own configured k, and an
+        # explicit bound below it is a construction error — otherwise every
+        # default-k submit would fail at runtime instead
+        if k_max is not None and int(k_max) < self.k:
+            raise ValueError(f"k_max={int(k_max)} is below this engine's "
+                             f"default k={self.k}")
+        self.k_max = int(k_max) if k_max is not None \
+            else max(DEFAULT_K_MAX, self.k)
+        #: whether this replica runs the mesh-sharded large-k path — the
+        #: replica router's classification bit (serving/frontend/router.py)
+        self.sharded = False
+        #: op -> (jitted program, takes k?) — instance-level so mesh-backed
+        #: subclasses swap programs without touching the dispatch machinery
+        self._programs: Dict[str, tuple] = dict(PROGRAMS)
         self.timeout_s = timeout_s
         self.ladder = ladder or BucketLadder.powers_of_two(max_batch)
         if self.ladder.max_batch != max_batch:
@@ -188,10 +217,15 @@ class ServingEngine:
         A bare ``submit(...).result()`` with neither will wait forever —
         timeouts too are evaluated at pump time, by design (no timer
         thread)."""
-        if op not in PROGRAMS:
-            raise ValueError(f"unknown op {op!r}; choose {sorted(PROGRAMS)}")
-        _, takes_k = PROGRAMS[op]
-        k = (self.k if k is None else int(k)) if takes_k else 0
+        if op not in self._programs:
+            raise ValueError(f"unknown op {op!r}; choose "
+                             f"{sorted(self._programs)}")
+        _, takes_k = self._programs[op]
+        # typed bad_request for out-of-range k at the engine boundary: a k
+        # past k_max must never reach program build (for the single-device
+        # static-k programs that would be a silent giant compile)
+        k = validate_k(self.k if k is None else k, self.k_max) \
+            if takes_k else 0
         row = as_row(row, self.row_dims[op], op)
         now = self._clock()
         if seed is not None and not 0 <= int(seed) < 2 ** 31:
@@ -367,7 +401,7 @@ class ServingEngine:
         the live path and :meth:`warmup` so both hit the same registry key."""
         import jax
 
-        program, takes_k = PROGRAMS[op]
+        program, takes_k = self._programs[op]
         # ONE explicit transfer per dispatch (transfer_guard-clean), not
         # two: device_put dispatch overhead is dispatcher-thread GIL time
         # that competes with the completion stage in the pipelined mode
@@ -381,6 +415,12 @@ class ServingEngine:
 
     def _build_key(self, op: str, k: int, bucket: int) -> tuple:
         return (op, self.cfg, k, bucket)
+
+    def _aot_name(self, op: str) -> str:
+        """Registry/span name of the op's program (subclasses that swap in
+        a different program for the same op name rename it here so the AOT
+        accounting and the audit suite agree on program identity)."""
+        return f"serve_{op}"
 
     def _launch(self, batch: List[Request]) -> _InFlight:
         """Stage one: pad, device_put, enqueue the async AOT dispatch.
@@ -397,7 +437,7 @@ class ServingEngine:
             np.stack([r.payload for r in batch]), bucket)
         seeds = np.zeros((bucket,), np.int32)
         seeds[:n] = [r.seed for r in batch]
-        program, _ = PROGRAMS[op]
+        program, _ = self._programs[op]
         args, kwargs, static = self._dispatch_args(op, k, payload, seeds)
         s0 = cache_stats()
         # spans nest: serve/dispatch/aot/serve_<op> — the outer one (in the
@@ -405,7 +445,7 @@ class ServingEngine:
         # completion (that is the completion stage's serve/complete span)
         with span(f"serve/dispatch/{op}", registry=self.metrics.registry):
             out = aot_call_async(
-                f"serve_{op}", program, args,
+                self._aot_name(op), program, args,
                 kwargs=kwargs, static_kwargs=static,
                 build_key=self._build_key(op, k, bucket))
         d = stats_delta(s0)
@@ -492,9 +532,9 @@ class ServingEngine:
         n_programs = 0
         with span("serve/warmup", registry=self.metrics.registry):
             for op in ops:
-                if op not in PROGRAMS:
+                if op not in self._programs:
                     raise ValueError(f"unknown op {op!r}")
-                program, takes_k = PROGRAMS[op]
+                program, takes_k = self._programs[op]
                 for k in (ks if takes_k else [0]):
                     for bucket in self.ladder.buckets:
                         payload = np.zeros((bucket, self.row_dims[op]),
@@ -502,8 +542,8 @@ class ServingEngine:
                         seeds = np.zeros((bucket,), np.int32)
                         args, kwargs, static = self._dispatch_args(
                             op, k, payload, seeds)
-                        aot_warm(f"serve_{op}", program, args, kwargs=kwargs,
-                                 static_kwargs=static,
+                        aot_warm(self._aot_name(op), program, args,
+                                 kwargs=kwargs, static_kwargs=static,
                                  build_key=self._build_key(op, k, bucket))
                         n_programs += 1
         d = stats_delta(s0)

@@ -92,6 +92,13 @@ class RemoteEngine:
         doc = info["result"]
         self.row_dims = {op: int(d) for op, d in doc["row_dims"].items()}
         self.k = doc.get("k")
+        # capability bits for a PARENT router's large-k classification:
+        # the child tier's fleet-wide k bound rides through, and a child
+        # that is ENTIRELY mesh-backed proxies as one sharded replica (a
+        # mixed child serves both classes itself, so it reads as fast)
+        self.k_max = doc.get("k_max")
+        self.sharded = bool(doc.get("sharded_replicas")) and \
+            doc.get("sharded_replicas") == doc.get("replicas")
         self.info = doc
         self._sock.settimeout(None)     # the reader blocks; handshake timed
         self._reader_thread = threading.Thread(

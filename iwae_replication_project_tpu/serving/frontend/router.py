@@ -18,6 +18,17 @@ id) and the per-replica engines:
   keep flowing to the replica whose AOT/jit caches (and, on hardware, its
   device-resident executables) are already warm for that bucket — load
   imbalance beyond the slack overrides affinity;
+* **large-k classification** — a fleet may mix single-device replicas with
+  mesh-backed :class:`~..sharded.ShardedScoreEngine` replicas
+  (``engine.sharded``). A ``score`` request with k above
+  ``large_k_threshold`` (default: the fast replicas' ``k_max``) is
+  eligible only for sharded replicas; everything else keeps the fast
+  single-device path (sharded replicas pick small traffic up only when
+  the fleet has no fast replica at all). Replicas also only ever see ops
+  they serve (``engine.row_dims``). An explicit request k outside
+  ``[1, max over replicas' k_max]`` is a synchronous ValueError — the
+  typed ``bad_request`` upstream — since no replica could legally take
+  it; selection then never has to reason about impossible asks;
 * **failure handling** — an engine that raises (at submit or via its
   future) marks its replica unhealthy and its outstanding work is
   re-dispatched to healthy peers *with the original seeds* (a reroute
@@ -64,6 +75,7 @@ from iwae_replication_project_tpu.serving.batcher import (
     RequestTimeout,
     complete_future,
 )
+from iwae_replication_project_tpu.serving.buckets import validate_k
 from iwae_replication_project_tpu.telemetry.registry import MetricRegistry
 
 __all__ = ["ReplicaRouter", "TierOverloaded", "ReplicaUnavailable"]
@@ -101,7 +113,8 @@ class _Replica:
     mutable field is guarded by the owning router's single lock, so the
     fleet has one synchronization domain, not N+1."""
 
-    __slots__ = ("index", "engine", "healthy", "outstanding", "last_error")
+    __slots__ = ("index", "engine", "healthy", "outstanding", "last_error",
+                 "sharded", "k_max", "ops")
 
     def __init__(self, index: int, engine):
         self.index = index
@@ -110,6 +123,17 @@ class _Replica:
         #: ticket -> _Tracked currently dispatched here (inflight = len)
         self.outstanding: Dict[int, _Tracked] = {}
         self.last_error: Optional[str] = None
+        # capability snapshot (immutable per engine): the classification
+        # bits _select filters on. Fakes without the attributes read as
+        # fast/unbounded/serve-everything — the pre-large-k behavior.
+        self.sharded = bool(getattr(engine, "sharded", False))
+        self.k_max: Optional[int] = getattr(engine, "k_max", None)
+        dims = getattr(engine, "row_dims", None)
+        self.ops: Optional[frozenset] = \
+            frozenset(dims) if dims is not None else None
+
+    def serves(self, op: str) -> bool:
+        return self.ops is None or op in self.ops
 
 
 class ReplicaRouter:
@@ -125,6 +149,7 @@ class ReplicaRouter:
                  affinity_slack: int = 2, stall_deadline_s: float = 30.0,
                  probe_timeout_s: float = 5.0,
                  probe_op: str = "score",
+                 large_k_threshold: Optional[int] = None,
                  registry: Optional[MetricRegistry] = None,
                  clock: Callable[[], float] = time.monotonic):
         if not engines:
@@ -142,6 +167,35 @@ class ReplicaRouter:
         self._lock = threading.Lock()
         self._empty = threading.Condition(self._lock)
         self._replicas = [_Replica(i, e) for i, e in enumerate(engines)]
+        # large-k classification (module docstring): the threshold above
+        # which score requests require a sharded replica. Default: the
+        # fast replicas' smallest k_max — the fast path serves everything
+        # it legally can, the mesh takes the rest. None when the fleet is
+        # homogeneous (nothing to classify).
+        self._has_fast = any(not r.sharded for r in self._replicas)
+        has_sharded = any(r.sharded for r in self._replicas)
+        if not has_sharded:
+            # no mesh-backed replica: nothing to classify onto — requests
+            # are bounded by k_max alone (a threshold here would turn
+            # valid k into spurious unavailable errors)
+            self.large_k_threshold = None
+        elif large_k_threshold is not None:
+            self.large_k_threshold: Optional[int] = int(large_k_threshold)
+        elif self._has_fast:
+            fast_maxes = [r.k_max for r in self._replicas
+                          if not r.sharded and r.k_max is not None]
+            # no fast replica exposes a bound (e.g. RemoteEngine proxies):
+            # fall back to NO classification rather than 0 — a 0 threshold
+            # would push every explicit-k request onto the sharded class
+            # and starve a perfectly capable fast path
+            self.large_k_threshold = min(fast_maxes) if fast_maxes else None
+        else:
+            self.large_k_threshold = None
+        #: the tier-wide k admission bound (None = engines enforce theirs):
+        #: max over replica k_max — a request k above it gets a synchronous
+        #: ValueError (typed bad_request), never an internal error
+        k_maxes = [r.k_max for r in self._replicas if r.k_max is not None]
+        self.k_max: Optional[int] = max(k_maxes) if k_maxes else None
         self._affinity: Dict[Tuple[str, Optional[int]], int] = {}
         self._seed_counter = 0
         self._ticket_counter = 0
@@ -203,7 +257,13 @@ class ReplicaRouter:
         a Future is returned, it ALWAYS completes — with a result, or with
         one of the typed errors above, or :class:`~..batcher.RequestTimeout`.
         """
-        k = int(k) if k is not None else None
+        if k is not None:
+            # typed bad_request at the tier boundary: an out-of-range k is
+            # rejected HERE, before it can occupy the ceiling or reach a
+            # replica as an internal error (the engines re-validate against
+            # their own k_max; this is the fleet-wide bound)
+            k = validate_k(k, self.k_max) if self.k_max is not None \
+                else validate_k(k, 2 ** 31 - 1)
         fut: Future = Future()
         with self._lock:
             if self._closed:
@@ -233,12 +293,34 @@ class ReplicaRouter:
 
     # -- selection + dispatch ----------------------------------------------
 
+    def _wants_sharded(self, op: str, k: Optional[int]) -> bool:
+        """Whether (op, k) belongs to the mesh-backed class: score above
+        the threshold (k=None means the replica default — always small)."""
+        return (op == "score" and self.large_k_threshold is not None
+                and k is not None and k > self.large_k_threshold)
+
+    def _eligible(self, r: _Replica, op: str, k: Optional[int]) -> bool:
+        """Capability filter under the classification policy: large-k score
+        needs a sharded replica; small traffic keeps the fast path (sharded
+        replicas pick it up only in an all-sharded fleet); a replica never
+        sees an op it does not serve or a k above its own bound."""
+        if not r.serves(op):
+            return False
+        if r.k_max is not None and k is not None and k > r.k_max:
+            return False
+        if self._wants_sharded(op, k):
+            return r.sharded
+        return not r.sharded or not self._has_fast
+
     def _select(self, group: Tuple[str, Optional[int]],
                 exclude: Set[int]) -> Optional[_Replica]:
         """Pick a replica (caller holds the lock): sticky group affinity
-        while balanced, else least-inflight with lowest-index tie-break."""
+        while balanced, else least-inflight with lowest-index tie-break —
+        over the replicas eligible for this (op, k) class."""
+        op, k = group
         cands = [r for r in self._replicas
-                 if r.healthy and r.index not in exclude]
+                 if r.healthy and r.index not in exclude
+                 and self._eligible(r, op, k)]
         if not cands:
             return None
         least = min(len(r.outstanding) for r in cands)
@@ -246,6 +328,7 @@ class ReplicaRouter:
         if aff is not None:
             ar = self._replicas[aff]
             if ar.healthy and aff not in exclude and \
+                    self._eligible(ar, op, k) and \
                     len(ar.outstanding) <= least + self.affinity_slack:
                 self._count("affinity_hits")
                 return ar
@@ -427,15 +510,18 @@ class ReplicaRouter:
             down = [r for r in self._replicas if not r.healthy]
             if not down:
                 return 0
-            template = self._replicas[0].engine
-        dims = template.row_dims[self.probe_op]
-        k = getattr(template, "k", None)
         readmitted = 0
         for r in down:
             self._count("probes")
             try:
-                probe_row = [0.0] * dims
-                ef = r.engine.submit(self.probe_op, probe_row, k=k, seed=0)
+                # probe each replica against ITS OWN contract (a mixed
+                # fast/sharded fleet has different row dims, ops, and k
+                # bounds per replica — a template probe would misfire)
+                op = self.probe_op if r.serves(self.probe_op) \
+                    else sorted(r.engine.row_dims)[0]
+                probe_row = [0.0] * r.engine.row_dims[op]
+                ef = r.engine.submit(op, probe_row,
+                                     k=getattr(r.engine, "k", None), seed=0)
                 ef.result(timeout=self.probe_timeout_s)
             except Exception:
                 continue      # still down; next monitor tick retries

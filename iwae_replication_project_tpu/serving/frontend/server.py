@@ -34,6 +34,7 @@ import socket
 import threading
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
+from iwae_replication_project_tpu.serving.buckets import validate_k
 from iwae_replication_project_tpu.serving.frontend import protocol
 from iwae_replication_project_tpu.serving.frontend.quotas import (
     ClientQuotas,
@@ -134,7 +135,14 @@ class _Connection:
                     f"{type(client).__name__}")
             k = obj.get("k")
             if k is not None:
-                k = int(k)
+                # the protocol surface of the typed out-of-range-k
+                # contract: the ONE shared validator (buckets.validate_k,
+                # type/positivity here; the fleet k_max range is the
+                # router's synchronous ValueError — same typed code)
+                try:
+                    k = validate_k(k, 2 ** 31 - 1)
+                except ValueError as e:
+                    raise protocol.ProtocolError(str(e)) from None
             seed = obj.get("seed")
             if seed is not None:
                 # the fleet-composition hook (protocol.py): one seed names
@@ -252,12 +260,14 @@ class ServingTier:
                  stall_deadline_s: float = 30.0,
                  probe_timeout_s: float = 5.0,
                  monitor_interval_s: float = 0.25,
+                 large_k_threshold: Optional[int] = None,
                  registry=None):
         self.router = ReplicaRouter(
             engines, max_outstanding=max_outstanding,
             affinity_slack=affinity_slack,
             stall_deadline_s=stall_deadline_s,
-            probe_timeout_s=probe_timeout_s, registry=registry)
+            probe_timeout_s=probe_timeout_s,
+            large_k_threshold=large_k_threshold, registry=registry)
         self.registry = self.router.registry
         self.quotas = ClientQuotas(quota)
         self._quota = quota
@@ -291,15 +301,37 @@ class ServingTier:
 
     def info(self) -> Dict[str, Any]:
         """The ``{"op": "info"}`` control response: what clients need to
-        size payloads and pace themselves."""
-        template = self.router.engines[0]
+        size payloads and pace themselves. Ops/dims are the UNION over the
+        fleet (a mixed fast + sharded tier serves the union; the router
+        keeps each request on replicas that serve its op)."""
+        row_dims: Dict[str, int] = {}
+        for e in self.router.engines:
+            row_dims.update(getattr(e, "row_dims", {}))
+        engines = self.router.engines
+        sharded = [e for e in engines if getattr(e, "sharded", False)]
+        # per-class templates: buckets/k describe the class that actually
+        # serves the request (a mixed tier has two ladders; engines[0]
+        # alone would misdescribe one class or the other)
+        fast_t = next((e for e in engines
+                       if not getattr(e, "sharded", False)),
+                      engines[0])
         return {
-            "ops": sorted(template.row_dims),
-            "row_dims": dict(template.row_dims),
-            "k": getattr(template, "k", None),
-            "buckets": list(getattr(getattr(template, "ladder", None),
+            "ops": sorted(row_dims),
+            "row_dims": row_dims,
+            "k": getattr(fast_t, "k", None),
+            "k_max": self.router.k_max,
+            "large_k_threshold": self.router.large_k_threshold,
+            "sharded_replicas": len(sharded),
+            "sharded": ({
+                "buckets": list(getattr(getattr(sharded[0], "ladder",
+                                                None), "buckets", ())),
+                "k_chunk": sharded[0].menu.k_chunk,
+                "k_max": sharded[0].k_max,
+                "k": getattr(sharded[0], "k", None),
+            } if sharded and hasattr(sharded[0], "menu") else None),
+            "buckets": list(getattr(getattr(fast_t, "ladder", None),
                                     "buckets", ())),
-            "replicas": len(self.router.engines),
+            "replicas": len(engines),
             "max_outstanding": self.router.max_outstanding,
             "quota": ({"rate": self._quota.rate, "burst": self._quota.burst}
                       if self._quota is not None else None),
@@ -326,10 +358,15 @@ class ServingTier:
                ks=None) -> Dict[str, float]:
         """Warm every replica's bucket ladder (AOT pre-compile); replicas
         share the process AOT registry in-process, so replica 2+ warmups
-        are registry hits. Returns summed warmup stats."""
+        are registry hits. Each replica warms only the ops it serves (a
+        sharded replica's menu is score-only). Returns summed stats."""
         total: Dict[str, float] = {}
         for e in self.router.engines:
-            w = e.warmup(ops=tuple(ops), ks=ks)
+            mine = tuple(op for op in ops
+                         if op in getattr(e, "row_dims", {}))
+            if not mine:
+                continue
+            w = e.warmup(ops=mine, ks=ks)
             for key, v in w.items():
                 total[key] = total.get(key, 0.0) + v
         return total

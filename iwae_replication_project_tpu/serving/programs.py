@@ -19,6 +19,7 @@ ladder intends.
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
@@ -70,6 +71,60 @@ def decode_rows(params, cfg: model.ModelConfig, base_key: jax.Array,
     return jax.vmap(row)(seeds, h_top)
 
 
+@functools.lru_cache(maxsize=32)
+def make_sharded_score_rows(cfg: model.ModelConfig, mesh, k_chunk: int = 250):
+    """The mesh-sharded large-k ``score`` program:
+    ``(params, base_key, seeds[B], x[B, d], k[int32 scalar]) -> [B]``.
+
+    The paper's flagship evaluation (k=5000 NLL, arXiv:1509.00519) and the
+    serving ``score`` op are the same computation at different k; this
+    program serves both from one executable. Batch rows shard over ``dp``;
+    the k sample axis streams over ``sp`` in fixed ``k_chunk`` blocks
+    through parallel/eval.py's online-logsumexp carry, and the per-device
+    carries merge with one ``pmax`` + one ``psum``
+    (:func:`~...parallel.eval._merge_lse_over_sp`).
+
+    Two properties carry the serving contract:
+
+    * **per-request RNG** — block ``g`` of row ``i`` draws from
+      ``fold_in(fold_in(base_key, seeds[i]), g)`` with ``g`` the *global*
+      block index, so every row's sampled weights are bitwise independent
+      of coalescing, padding, block scheduling, and mesh shape (the
+      reduction is then bitwise-reproducible per (mesh, k_chunk) — the
+      offline scorer :func:`~...parallel.eval.sharded_score_offline` calls
+      this very program, making offline/online parity exact);
+    * **dynamic k** — ``k`` is a traced scalar, not a static: the block
+      loop is a dynamic ``fori_loop`` and the ragged tail is masked to
+      ``-inf``, so one executable per batch bucket serves every
+      ``k in [1, k_max]`` — a warmed engine takes a ragged (batch, k)
+      stream with zero recompiles (tightness-vs-cost around k, Rainforth
+      et al. arXiv:1802.04537, becomes a per-request knob).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from iwae_replication_project_tpu.parallel.eval import (
+        _local_row_streaming_log_px,
+        _merge_lse_over_sp,
+    )
+    from iwae_replication_project_tpu.parallel.mesh import AXES, shard_map
+
+    n_sp = mesh.shape[AXES.sp]
+
+    def local_fn(params, base_key, seeds_local, x_local, k_dyn):
+        state = _local_row_streaming_log_px(params, cfg, base_key,
+                                            seeds_local, x_local, k_dyn,
+                                            k_chunk, n_sp)
+        _, safe, s_g = _merge_lse_over_sp(state)
+        return jnp.log(s_g) + safe - jnp.log(k_dyn.astype(jnp.float32))
+
+    return jax.jit(shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(), P(), P(AXES.dp), P(AXES.dp), P()),
+        out_specs=P(AXES.dp),
+        check_vma=False,
+    ))
+
+
 #: op name -> (jitted program, takes static k?)
 PROGRAMS = {
     "score": (score_rows, True),
@@ -89,4 +144,7 @@ PADDED_ROW_KWARGS = {
     "score": ("seeds", "x"),
     "encode": ("seeds", "x"),
     "decode": ("seeds", "h_top"),
+    # the mesh-sharded large-k score program (make_sharded_score_rows):
+    # same per-row payload contract, dispatched by ShardedScoreEngine
+    "score_sharded": ("seeds", "x"),
 }

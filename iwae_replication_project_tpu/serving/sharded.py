@@ -1,0 +1,160 @@
+"""ShardedScoreEngine: paper-grade k behind the serving API.
+
+The paper's flagship number is the k=5000 NLL; ``parallel/eval.py`` already
+shards that computation over the ``(dp, sp)`` mesh, but the base
+:class:`~.engine.ServingEngine` tops out at single-device static-k
+programs. This engine is the missing join: the SAME request lifecycle
+(micro-batcher -> bucket pad -> AOT dispatch -> in-flight window ->
+completion slice), with the ``score`` op swapped for the mesh-sharded
+dynamic-k program (serving/programs.make_sharded_score_rows):
+
+* **batch rows shard over dp, k blocks stream over sp** — one dispatch per
+  coalesced batch, however large k is; the cross-device merge is one
+  ``pmax`` + one ``psum`` of the online-logsumexp carry;
+* **k is a dynamic scalar**, so the AOT menu is 2-D in shape but 1-D in
+  executables: one program per batch bucket serves every ``k`` in
+  ``[1, k_max]`` (:class:`~.buckets.KChunkMenu`) — a warmed engine takes a
+  ragged (batch, k) request stream with zero recompiles, which is what
+  makes per-request k a traffic-scale knob rather than an offline job;
+* **per-request RNG** — block ``g`` of a row draws from
+  ``fold_in(fold_in(base_key, seed), g)``: results are bitwise independent
+  of coalescing, padding, and block scheduling, and bitwise IDENTICAL to
+  the offline scorer (``parallel/eval.sharded_score_offline`` calls the
+  same jitted program);
+* **device memory stays bounded by the existing pipeline**: each k=5000
+  dispatch is ONE in-flight window slot whose working set is
+  O(bucket x k_chunk), never O(bucket x k) — the window's
+  ``max_inflight`` bound and the queue shed carry over unchanged.
+
+Requests coalesce per (op, k) exactly as before, so mixed-k traffic forms
+per-k batches that all hit the same executable. The replica router
+(serving/frontend/router.py) classifies ``score`` requests above its k
+threshold onto engines with ``sharded=True`` — this class — while small-k
+traffic keeps the single-device fast path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from iwae_replication_project_tpu.serving.buckets import (
+    BucketLadder,
+    KChunkMenu,
+)
+from iwae_replication_project_tpu.serving.engine import ServingEngine
+
+__all__ = ["ShardedScoreEngine"]
+
+
+def default_sharded_ladder(dp: int, max_batch: int) -> BucketLadder:
+    """Power-of-two-style batch ladder where every rung is a dp multiple
+    (shard_map needs equal per-device row shards): ``dp * (1, 2, 4, ...)``
+    up to ``max_batch`` (floored to ``dp`` when smaller)."""
+    rungs = []
+    b = dp
+    while b < max_batch:
+        rungs.append(b)
+        b *= 2
+    rungs.append(max(max_batch - max_batch % dp, dp) if max_batch >= dp
+                 else dp)
+    return BucketLadder(tuple(sorted(set(rungs))))
+
+
+class ShardedScoreEngine(ServingEngine):
+    """Mesh-backed ``score``-only serving replica (see module docstring).
+
+    ``mesh`` is a ``(dp, sp)`` :class:`jax.sharding.Mesh`
+    (parallel/mesh.make_mesh; default: all local devices on ``sp`` — k is
+    the axis that scales). ``k_chunk`` is the canonical sample-block size
+    (it versions the RNG stream: results are a pure function of
+    (weights, payload, seed, k, k_chunk)); ``k_max`` the typed admission
+    bound. Batch ladder rungs must be dp multiples (default ladder
+    complies). Everything else — coalescing, pipeline, timeouts, metrics —
+    is the base engine.
+    """
+
+    def __init__(self, source=None, *, params=None, model_config=None,
+                 mesh=None, k_chunk: int = 250, k_max: int = 5000,
+                 k: Optional[int] = None, max_batch: int = 8,
+                 ladder: Optional[BucketLadder] = None, **kw):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from iwae_replication_project_tpu.parallel.mesh import AXES, make_mesh
+        from iwae_replication_project_tpu.serving.programs import (
+            make_sharded_score_rows)
+
+        if mesh is None:
+            mesh = make_mesh(dp=1, sp=jax.device_count())
+        dp = mesh.shape[AXES.dp]
+        if ladder is None:
+            ladder = default_sharded_ladder(dp, max_batch)
+        bad = [b for b in ladder.buckets if b % dp != 0]
+        if bad:
+            raise ValueError(f"sharded batch buckets must be multiples of "
+                             f"dp={dp}; got {bad}")
+        # k_max deliberately NOT passed to super: the menu owns the bound
+        # here, and an inherited default k (checkpoint / base 50) above it
+        # CLAMPS instead of failing construction — only an explicit k must
+        # fit the menu
+        super().__init__(source, params=params, model_config=model_config,
+                         k=k, max_batch=ladder.max_batch,
+                         ladder=ladder, **kw)
+        self.menu = KChunkMenu(batch=ladder, k_chunk=int(k_chunk),
+                               k_max=int(k_max))
+        if k is None:
+            self.k = min(self.k, int(k_max))
+        self.k = self.menu.validate_k(self.k)
+        self.k_max = int(k_max)
+        self.mesh = mesh
+        self.sharded = True
+        # one program, one op: this replica IS the large-k scoring service
+        self._programs = {
+            "score": (make_sharded_score_rows(self.cfg, mesh,
+                                              self.menu.k_chunk), True),
+        }
+        self.row_dims = {"score": self.cfg.x_dim}
+        # re-commit weights + base key replicated over the mesh so every
+        # dispatch's input shardings (hence its AOT signature) are stable
+        self._params = jax.device_put(self._params,
+                                      NamedSharding(mesh, P()))
+        self._base_key = jax.device_put(self._base_key,
+                                        NamedSharding(mesh, P()))
+        self._row_spec = NamedSharding(mesh, P(AXES.dp))
+        self._scalar_spec = NamedSharding(mesh, P())
+
+    # -- dispatch plumbing (the two hooks the base engine dispatches via) --
+
+    def _dispatch_args(self, op: str, k: int, payload: np.ndarray,
+                       seeds: np.ndarray) -> Tuple[tuple, dict, dict]:
+        """Positional args of one sharded dispatch: payload/seed rows shard
+        over dp, k rides as a replicated dynamic scalar — NOT a static —
+        so every k shares the bucket's one executable."""
+        import jax
+
+        payload_dev, seeds_dev = jax.device_put((payload, seeds),
+                                                self._row_spec)
+        k_arr = jax.device_put(np.int32(k), self._scalar_spec)
+        return ((self._params, self._base_key, seeds_dev, payload_dev,
+                 k_arr), {}, {})
+
+    def _build_key(self, op: str, k: int, bucket: int) -> tuple:
+        from iwae_replication_project_tpu.utils.compile_cache import (
+            mesh_fingerprint)
+
+        # k deliberately absent: the dynamic-k program's identity is
+        # (config, chunk, mesh, bucket) — the zero-recompile contract
+        return ("score_sharded", self.cfg, self.menu.k_chunk,
+                mesh_fingerprint(self.mesh), bucket)
+
+    def _aot_name(self, op: str) -> str:
+        return "serve_score_sharded"
+
+    def warmup(self, ops: Sequence[str] = ("score",),
+               ks: Optional[Iterable[int]] = None) -> Dict[str, float]:
+        """Pre-compile the batch ladder — one executable per rung covers
+        the WHOLE k range (k is dynamic), so ``ks`` is only the probe value
+        traced through (default: the engine's k)."""
+        return super().warmup(ops=ops, ks=ks)

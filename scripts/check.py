@@ -16,8 +16,12 @@ machine-readable summary.
 5. **serving tier smoke** (scripts/serving_tier_smoke.py) — the network
    tier over a real socket with a replica killed mid-burst: zero lost
    responses, zero recompiles, bitwise parity with a direct engine;
-6. **hot-loop smoke** (scripts/hot_loop_smoke.py);
-7. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
+6. **large-k smoke** (scripts/large_k_smoke.py) — a k=5000 score request
+   through the warm mesh-backed engine: bitwise parity with the offline
+   ``parallel/eval`` scorer and zero recompiles over a ragged (batch, k)
+   stream;
+7. **hot-loop smoke** (scripts/hot_loop_smoke.py);
+8. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
    ``--sanitize`` armed.
 
 Every full-gate run writes ``results/check_summary.json`` (per-stage status,
@@ -146,6 +150,12 @@ def run_serving_tier_smoke() -> dict:
                                                   "serving_tier_smoke.py")])
 
 
+def run_large_k_smoke() -> dict:
+    return run_step("large-k smoke",
+                    [sys.executable, os.path.join("scripts",
+                                                  "large_k_smoke.py")])
+
+
 def run_hot_loop_smoke() -> dict:
     return run_step("hot-loop smoke",
                     [sys.executable, os.path.join("scripts",
@@ -192,6 +202,7 @@ def main(argv=None) -> int:
         stages.append(run_telemetry_smoke())
         stages.append(run_serving_smoke())
         stages.append(run_serving_tier_smoke())
+        stages.append(run_large_k_smoke())
         stages.append(run_hot_loop_smoke())
     if not args.lint_only:
         stages.append(run_tests(passthrough))

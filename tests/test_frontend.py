@@ -231,7 +231,7 @@ def test_replica_failure_reroutes_zero_lost_futures():
     bad, good = FakeEngine("manual"), FakeEngine("manual")
     r = ReplicaRouter([bad, good], affinity_slack=0)
     # alternate (op, k) groups so both replicas hold work
-    futs = [r.submit("score", [1, 1, 1, 1], k=(i % 2)) for i in range(8)]
+    futs = [r.submit("score", [1, 1, 1, 1], k=(i % 2) + 1) for i in range(8)]
     assert bad.submitted == 4 and good.submitted == 4
     # replica 0 dies: its oldest future errors, the rest of its work is
     # drained and rerouted to the healthy peer WITH the original seeds
@@ -334,7 +334,7 @@ def test_probe_readmission():
 def test_drain_on_stop_completes_everything():
     engines = [FakeEngine("manual") for _ in range(2)]
     r = ReplicaRouter(engines, affinity_slack=0)
-    futs = [r.submit("score", [1, 1, 1, 1], k=(i % 2)) for i in range(6)]
+    futs = [r.submit("score", [1, 1, 1, 1], k=(i % 2) + 1) for i in range(6)]
     # drain: intake closes, engine.stop() flushes held work, all complete
     r.drain(timeout_s=5)
     assert all(e.stopped for e in engines)
@@ -576,7 +576,7 @@ def test_tier_mid_burst_replica_kill_loses_nothing():
     tier.start()
     try:
         with TierClient("127.0.0.1", tier.port) as c:
-            ids = [c.submit("score", [[float(i), 0, 0, 0]], k=(i % 2))
+            ids = [c.submit("score", [[float(i), 0, 0, 0]], k=(i % 2) + 1)
                    for i in range(12)]
             # wait for the burst to spread over both replicas, then kill one
             wait_until(lambda: bad.submitted + good.submitted == 12,
@@ -703,7 +703,7 @@ def test_parent_router_over_remote_tiers():
         rem_a = RemoteEngine("127.0.0.1", child_a.port)
         rem_b = RemoteEngine("127.0.0.1", child_b.port)
         parent = ReplicaRouter([rem_a, rem_b], affinity_slack=0)
-        got = [parent.submit("score", [1.0, 0, 0, 0], k=(i % 2))
+        got = [parent.submit("score", [1.0, 0, 0, 0], k=(i % 2) + 1)
                .result(timeout=5) for i in range(6)]
         # parent-minted seeds (admission order) determine results, NOT which
         # child served: bitwise independent of process placement

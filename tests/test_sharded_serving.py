@@ -1,0 +1,648 @@
+"""Sharded large-k scoring service tests (ISSUE 9).
+
+Layers, bottom up:
+
+* ``_merge_lse_over_sp`` — the cross-device online-logsumexp merge, unit-
+  tested directly under shard_map on the fake-device mesh, including
+  ragged final chunks and the all-``-inf`` row edge case;
+* the sharded score program — matched-RNG parity with a host-loop
+  reference across mesh shapes, ragged k (k not divisible by k_chunk),
+  and idle-device block schedules;
+* ``ShardedScoreEngine`` — bitwise parity with the offline
+  ``parallel/eval.sharded_score_offline`` scorer through the padded
+  bucket path, zero recompiles over a ragged (batch, k) stream, and the
+  typed out-of-range-k rejection at the engine boundary;
+* the replica router — large-k classification onto sharded replicas with
+  fake engines, the fleet-wide k bound, and the typed ``bad_request``
+  surfaces at the router and over the wire.
+"""
+
+import threading
+from concurrent.futures import Future
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from iwae_replication_project_tpu.models import iwae as model
+from iwae_replication_project_tpu.ops.logsumexp import (
+    OnlineLSE,
+    online_logsumexp_init,
+    online_logsumexp_merge,
+    online_logsumexp_update,
+)
+from iwae_replication_project_tpu.parallel import make_mesh
+from iwae_replication_project_tpu.parallel.eval import (
+    _merge_lse_over_sp,
+    sharded_score_offline,
+)
+from iwae_replication_project_tpu.parallel.mesh import AXES, shard_map
+from iwae_replication_project_tpu.serving import (
+    BucketLadder,
+    KChunkMenu,
+    ServingEngine,
+    ShardedScoreEngine,
+)
+from iwae_replication_project_tpu.serving.buckets import validate_k
+
+D = 12
+CFG = model.ModelConfig(n_hidden_enc=(16, 8), n_latent_enc=(6, 3),
+                        n_hidden_dec=(8, 16), n_latent_dec=(6, 12), x_dim=D)
+CHUNK = 4
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    params = model.init_params(jax.random.PRNGKey(0), CFG)
+    x = (np.random.RandomState(0).rand(9, D) > 0.5).astype(np.float32)
+    return {"params": params, "x": x,
+            "base_key": jax.device_put(jax.random.PRNGKey(7))}
+
+
+def make_sharded(tiny, mesh, **kw):
+    kw.setdefault("k_chunk", CHUNK)
+    kw.setdefault("k_max", 100)
+    kw.setdefault("k", 8)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("timeout_s", 60.0)
+    return ShardedScoreEngine(params=tiny["params"], model_config=CFG,
+                              mesh=mesh, **kw)
+
+
+# ---------------------------------------------------------------------------
+# the 2-D (batch_bucket, k) menu + the shared k validator
+# ---------------------------------------------------------------------------
+
+def test_k_chunk_menu():
+    menu = KChunkMenu(batch=BucketLadder((2, 4, 8)), k_chunk=250, k_max=5000)
+    assert menu.validate_k(1) == 1
+    assert menu.validate_k(5000) == 5000
+    assert menu.n_chunks(250) == 1
+    assert menu.n_chunks(251) == 2      # ragged final chunk
+    assert menu.n_chunks(5000) == 20
+    for bad in (0, -3, 5001):
+        with pytest.raises(ValueError, match="out of range"):
+            menu.validate_k(bad)
+    for bad in ("50", 2.5, True, None):
+        with pytest.raises(ValueError, match="integer"):
+            menu.validate_k(bad)
+    with pytest.raises(ValueError, match="k_chunk"):
+        KChunkMenu(batch=BucketLadder((2,)), k_chunk=0)
+    with pytest.raises(ValueError, match="k_max"):
+        KChunkMenu(batch=BucketLadder((2,)), k_max=0)
+
+
+def test_validate_k_accepts_numpy_integers():
+    assert validate_k(np.int32(7), 10) == 7
+    assert isinstance(validate_k(np.int64(7), 10), int)
+
+
+# ---------------------------------------------------------------------------
+# _merge_lse_over_sp: the cross-device merge, in isolation
+# ---------------------------------------------------------------------------
+
+def _run_merge(mesh, m, s):
+    """Feed per-device partial states ``m, s [sp, B]`` through the real
+    merge under shard_map; returns host (m_g, safe, s_g)."""
+    def local(m_l, s_l):
+        state = OnlineLSE(m=m_l[0], s=s_l[0], n=jnp.int32(0))
+        return _merge_lse_over_sp(state)
+
+    fn = jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(P(AXES.sp), P(AXES.sp)),
+        out_specs=(P(), P(), P()),
+        check_vma=False))
+    return tuple(np.asarray(v) for v in fn(jnp.asarray(m), jnp.asarray(s)))
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_merge_matches_sequential_associative_merge(devices, sp):
+    mesh = make_mesh(dp=1, sp=sp)
+    rng = np.random.RandomState(3)
+    m = rng.randn(sp, 5).astype(np.float32) * 10
+    s = rng.rand(sp, 5).astype(np.float32) + 0.1
+    m_g, safe, s_g = _run_merge(mesh, m, s)
+    want = OnlineLSE(m=jnp.asarray(m[0]), s=jnp.asarray(s[0]),
+                     n=jnp.int32(0))
+    for i in range(1, sp):
+        want = online_logsumexp_merge(
+            want, OnlineLSE(m=jnp.asarray(m[i]), s=jnp.asarray(s[i]),
+                            n=jnp.int32(0)))
+    np.testing.assert_array_equal(m_g, np.asarray(want.m))
+    np.testing.assert_allclose(s_g, np.asarray(want.s), rtol=1e-6)
+    # the finalized log p̂ the program computes from (safe, s_g)
+    np.testing.assert_allclose(
+        np.log(s_g) + safe,
+        np.asarray(jnp.log(want.s)
+                   + jnp.where(jnp.isfinite(want.m), want.m, 0.0)),
+        rtol=1e-6)
+
+
+def test_merge_idle_device_contributes_exact_zero(devices):
+    """A device whose blocks were all masked (its whole k range is beyond
+    k) carries (m=-inf, s=0) — the merge must treat that as an EXACT zero
+    contribution, not a NaN."""
+    mesh = make_mesh(dp=1, sp=2)
+    m = np.stack([np.array([1.0, -2.0], np.float32),
+                  np.full((2,), -np.inf, np.float32)])
+    s = np.stack([np.array([0.5, 1.5], np.float32),
+                  np.zeros((2,), np.float32)])
+    m_g, safe, s_g = _run_merge(mesh, m, s)
+    np.testing.assert_array_equal(m_g, m[0])
+    np.testing.assert_array_equal(safe, m[0])
+    np.testing.assert_array_equal(s_g, s[0])   # bitwise: + 0 exactly
+
+
+def test_merge_all_devices_all_inf_rows(devices):
+    """ALL devices all--inf for a row (no live sample anywhere): the merge
+    must produce s_g=0 with a finite 'safe' max, so the finalize yields
+    -inf — never NaN (the exp(-inf - -inf) trap)."""
+    mesh = make_mesh(dp=1, sp=2)
+    m = np.full((2, 3), -np.inf, np.float32)
+    s = np.zeros((2, 3), np.float32)
+    m_g, safe, s_g = _run_merge(mesh, m, s)
+    assert np.all(np.isneginf(m_g))
+    np.testing.assert_array_equal(safe, np.zeros(3, np.float32))
+    np.testing.assert_array_equal(s_g, np.zeros(3, np.float32))
+    with np.errstate(divide="ignore"):
+        out = np.log(s_g) + safe   # the program's finalize: log(0) = -inf
+    assert np.all(np.isneginf(out)) and not np.any(np.isnan(out))
+
+
+def test_merge_of_ragged_chunk_states_matches_flat_logsumexp(devices):
+    """Per-device carries built from RAGGED chunk splits (different chunk
+    boundaries per device) merge to the same logsumexp as one flat pass —
+    the associativity the sharded scorer leans on."""
+    mesh = make_mesh(dp=1, sp=2)
+    rng = np.random.RandomState(5)
+    blocks = [rng.randn(n, 4).astype(np.float32)
+              for n in (3, 1, 2, 5)]       # ragged chunks
+    halves = [blocks[:2], blocks[2:]]
+    m, s = [], []
+    for chunks in halves:
+        st = online_logsumexp_init((4,))
+        for c in chunks:
+            st = online_logsumexp_update(st, jnp.asarray(c), axis=0)
+        m.append(np.asarray(st.m))
+        s.append(np.asarray(st.s))
+    m_g, safe, s_g = _run_merge(mesh, np.stack(m), np.stack(s))
+    flat = np.concatenate(blocks, axis=0)
+    want = np.log(np.sum(np.exp(flat - flat.max(0)), axis=0)) + flat.max(0)
+    np.testing.assert_allclose(np.log(s_g) + safe, want, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# the sharded program: matched-RNG reference across mesh shapes + ragged k
+# ---------------------------------------------------------------------------
+
+def _served_cfg():
+    """The config the engine actually serves (the fused-likelihood pin)."""
+    import dataclasses
+    return dataclasses.replace(CFG, fused_likelihood=False)
+
+
+def _reference_scores(tiny, seeds, x, k, chunk=CHUNK):
+    """Host-loop twin of the sharded program's RNG/merge contract: per row,
+    draw ceil(k/chunk) canonical blocks keyed fold_in(fold_in(base, seed),
+    g), mask global sample index >= k to -inf, fold through the online
+    carry in block order."""
+    cfg = _served_cfg()
+    out = []
+    n_blocks = -(-k // chunk)
+    for seed, row in zip(seeds, x):
+        st = online_logsumexp_init((1,))
+        for g in range(n_blocks):
+            key = jax.random.fold_in(
+                jax.random.fold_in(tiny["base_key"], int(seed)), g)
+            lw = model.log_weights(tiny["params"], cfg, key, row[None],
+                                   chunk)[:, 0]
+            idx = g * chunk + np.arange(chunk)
+            lw = jnp.where(jnp.asarray(idx) < k, lw, -jnp.inf)
+            st = online_logsumexp_update(st, lw[:, None], axis=0)
+        safe = jnp.where(jnp.isfinite(st.m), st.m, 0.0)
+        out.append(float((jnp.log(st.s) + safe - jnp.log(float(k)))[0]))
+    return np.array(out, np.float32)
+
+
+@pytest.mark.parametrize("k", [1, 3, 8, 10, 17])
+@pytest.mark.parametrize("dp,sp", [(1, 1), (2, 2), (1, 4)])
+def test_sharded_program_matches_reference(devices, tiny, dp, sp, k):
+    """The program == the host-loop matched-RNG reference for every mesh
+    shape, including ragged final chunks (k % chunk != 0) and idle devices
+    (fewer blocks than sp)."""
+    mesh = make_mesh(dp=dp, sp=sp)
+    seeds = np.arange(4, dtype=np.int32)
+    x = tiny["x"][:4]
+    got = np.asarray(sharded_score_offline(
+        tiny["params"], _served_cfg(), mesh, tiny["base_key"], seeds, x, k,
+        k_chunk=CHUNK))
+    want = _reference_scores(tiny, seeds, x, k)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_sharded_program_k_independent_of_mesh_samples(devices, tiny):
+    """RNG is keyed by GLOBAL block index: the same (seed, k, chunk) must
+    agree across mesh shapes to float tolerance (the sampled weights are
+    bitwise identical; only the merge order differs)."""
+    seeds = np.arange(4, dtype=np.int32)
+    x = tiny["x"][:4]
+    outs = [np.asarray(sharded_score_offline(
+        tiny["params"], _served_cfg(), make_mesh(dp=dp, sp=sp),
+        tiny["base_key"], seeds, x, 17, k_chunk=CHUNK))
+        for dp, sp in ((1, 1), (2, 2), (1, 8))]
+    for other in outs[1:]:
+        np.testing.assert_allclose(outs[0], other, rtol=1e-6, atol=1e-7)
+
+
+def test_offline_scorer_pads_ragged_batches(devices, tiny):
+    """A batch not divisible by dp pads invisibly (per-row RNG): the 3-row
+    result on a dp=2 mesh == the same rows scored in a 4-row batch."""
+    mesh = make_mesh(dp=2, sp=2)
+    seeds = np.arange(3, dtype=np.int32)
+    got = np.asarray(sharded_score_offline(
+        tiny["params"], _served_cfg(), mesh, tiny["base_key"], seeds,
+        tiny["x"][:3], 10, k_chunk=CHUNK))
+    full = np.asarray(sharded_score_offline(
+        tiny["params"], _served_cfg(), mesh, tiny["base_key"],
+        np.arange(4, dtype=np.int32), tiny["x"][:4], 10, k_chunk=CHUNK))
+    np.testing.assert_array_equal(got, full[:3])
+
+
+# ---------------------------------------------------------------------------
+# ShardedScoreEngine: bucket parity, dynamic-k warm path, typed rejection
+# ---------------------------------------------------------------------------
+
+def test_sharded_engine_bitwise_parity_with_offline_scorer(devices, tiny):
+    """Engine-served ragged batches == the offline parallel/eval scorer at
+    the engine's minted seeds, BITWISE — through coalescing, bucket
+    padding, and slicing. The serving API is the paper's evaluation."""
+    mesh = make_mesh(dp=2, sp=2)
+    eng = make_sharded(tiny, mesh)
+    seed = 0
+    for n, k in ((1, 3), (3, 8), (7, 17), (2, 100)):
+        got = eng.score(tiny["x"][:n], k=k)
+        off = np.asarray(sharded_score_offline(
+            tiny["params"], eng.cfg, mesh, eng._base_key,
+            np.arange(seed, seed + n, dtype=np.int32), tiny["x"][:n], k,
+            k_chunk=CHUNK))
+        seed += n
+        assert got.dtype == off.dtype
+        assert np.array_equal(np.atleast_1d(got), off), (n, k)
+
+
+def test_paper_grade_k5000_served_bitwise_equal_to_offline(devices, tiny):
+    """THE acceptance pin (ISSUE 9): a k=5000 score request served through
+    the engine — production k_chunk=250, so the real 20-block stream —
+    returns the bitwise-identical log p̂(x) the offline parallel/eval
+    scorer computes, with zero recompiles after warmup."""
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        cache_stats, stats_delta)
+
+    mesh = make_mesh(dp=1, sp=2)
+    eng = make_sharded(tiny, mesh, k_chunk=250, k_max=5000, k=50,
+                       max_batch=2)
+    eng.warmup()
+    s0 = cache_stats()
+    got = eng.score(tiny["x"][0], k=5000)
+    assert np.isfinite(got)
+    d = stats_delta(s0)
+    assert d["aot_misses"] == 0 and d["persistent_cache_misses"] == 0
+    off = np.asarray(sharded_score_offline(
+        tiny["params"], eng.cfg, mesh, eng._base_key,
+        np.zeros((1,), np.int32), tiny["x"][0][None], 5000, k_chunk=250))
+    assert np.array_equal(np.asarray(got), off[0])
+
+
+def test_sharded_engine_zero_recompiles_over_ragged_batch_and_k(devices,
+                                                               tiny):
+    """THE tentpole pin: k is dynamic, so after warmup a ragged stream in
+    BOTH batch size and k hits zero AOT misses and zero XLA recompiles."""
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        cache_stats, stats_delta)
+
+    mesh = make_mesh(dp=2, sp=2)
+    eng = make_sharded(tiny, mesh)
+    warm = eng.warmup()
+    assert warm["programs"] == len(eng.ladder.buckets)
+    s0 = cache_stats()
+    futs = []
+    for n, k in ((1, 50), (3, 7), (2, 1), (8, 100), (5, 99), (1, 8),
+                 (4, 63)):
+        futs.extend(eng.submit("score", r, k=k) for r in tiny["x"][:n])
+    eng.flush()
+    for f in futs:
+        assert np.isfinite(f.result(timeout=60))
+    d = stats_delta(s0)
+    assert d["aot_misses"] == 0, f"ragged (batch, k) stream compiled: {d}"
+    c = eng.metrics.snapshot()["counters"]
+    assert c["recompiles"] == 0
+    assert c["aot_hits"] == c["dispatches"] > 0
+
+
+def test_sharded_engine_pipelined_matches_inline(devices, tiny):
+    """The two-stage pipeline (InflightWindow) dispatches multi-chunk
+    programs identically to inline flush: same seeds -> bitwise equal."""
+    mesh = make_mesh(dp=1, sp=2)
+
+    def run(start):
+        eng = make_sharded(tiny, mesh, max_inflight=2, max_wait_us=200.0)
+        if start:
+            eng.start()
+        try:
+            futs = [eng.submit("score", r, k=10) for r in tiny["x"][:5]]
+            if not start:
+                eng.flush()
+            return [np.asarray(f.result(timeout=120)) for f in futs]
+        finally:
+            if start:
+                eng.stop()
+
+    a, b = run(False), run(True)
+    for x, y in zip(a, b):
+        assert np.array_equal(x, y)
+
+
+def test_sharded_engine_rejects_out_of_range_k(devices, tiny):
+    """The typed bad_request at the engine boundary: ValueError before any
+    queueing or program build, for every invalid shape of k."""
+    eng = make_sharded(tiny, make_mesh(dp=1, sp=1))
+    for bad in (0, -1, 101):
+        with pytest.raises(ValueError, match="out of range"):
+            eng.submit("score", tiny["x"][0], k=bad)
+    for bad in (True, 2.5, "50"):
+        with pytest.raises(ValueError, match="integer"):
+            eng.submit("score", tiny["x"][0], k=bad)
+    with pytest.raises(ValueError, match="unknown op"):
+        eng.submit("encode", tiny["x"][0])   # score-only replica
+    assert eng.metrics.snapshot()["counters"]["submitted"] == 0
+
+
+def test_base_engine_rejects_out_of_range_k(tiny):
+    """The same contract on the single-device fast path, where an
+    unbounded k would otherwise be a silent giant compile."""
+    eng = ServingEngine(params=tiny["params"], model_config=CFG, k=4,
+                        k_max=16, max_batch=4)
+    with pytest.raises(ValueError, match="out of range"):
+        eng.submit("score", tiny["x"][0], k=17)
+    with pytest.raises(ValueError, match="integer"):
+        eng.submit("score", tiny["x"][0], k="many")
+    assert np.isfinite(eng.score(tiny["x"][0], k=16))   # the bound serves
+
+
+def test_base_engine_rejects_k_max_below_default_k(tiny):
+    """An explicit bound below the engine's own default k fails at
+    CONSTRUCTION — not at every later default-k submit."""
+    with pytest.raises(ValueError, match="below this engine's default"):
+        ServingEngine(params=tiny["params"], model_config=CFG, k=32,
+                      k_max=16, max_batch=4)
+
+
+def test_sharded_engine_requires_dp_aligned_buckets(devices, tiny):
+    with pytest.raises(ValueError, match="multiples of dp"):
+        ShardedScoreEngine(params=tiny["params"], model_config=CFG,
+                           mesh=make_mesh(dp=2, sp=1),
+                           ladder=BucketLadder((1, 2, 4)))
+
+
+def test_sharded_engine_default_k_must_fit_menu(devices, tiny):
+    with pytest.raises(ValueError, match="out of range"):
+        make_sharded(tiny, make_mesh(dp=1, sp=1), k=512, k_max=100)
+    # an INHERITED default (k unset: the base engine's 50) clamps to the
+    # menu; only an explicit out-of-menu k is a construction error
+    eng = ShardedScoreEngine(params=tiny["params"], model_config=CFG,
+                             mesh=make_mesh(dp=1, sp=1), k_chunk=4,
+                             k_max=10)
+    assert eng.k == 10
+
+
+# ---------------------------------------------------------------------------
+# router classification (fake engines — no device)
+# ---------------------------------------------------------------------------
+
+class FakeReplica:
+    """Minimal engine surface with capability attributes."""
+
+    def __init__(self, *, sharded=False, k_max=16, ops=("score", "encode",
+                                                        "decode"), dims=4):
+        self.sharded = sharded
+        self.k_max = k_max
+        self.k = 5
+        self.row_dims = {op: dims for op in ops}
+        self.served = []
+        self.lock = threading.Lock()
+
+    def submit(self, op, row, k=None, *, seed=None):
+        with self.lock:
+            self.served.append((op, k, seed))
+        f = Future()
+        f.set_result(float(seed if seed is not None else -1))
+        return f
+
+    def start(self):
+        pass
+
+    def stop(self, timeout_s=None):
+        pass
+
+    def warmup(self, ops=(), ks=None):
+        return {}
+
+
+def _mixed_router(**kw):
+    from iwae_replication_project_tpu.serving.frontend import ReplicaRouter
+
+    fast = FakeReplica(sharded=False, k_max=16)
+    big = FakeReplica(sharded=True, k_max=5000, ops=("score",))
+    return fast, big, ReplicaRouter([fast, big], **kw)
+
+
+def test_router_classifies_large_k_onto_sharded_replica():
+    fast, big, router = _mixed_router()
+    assert router.large_k_threshold == 16   # auto: the fast replica's k_max
+    assert router.k_max == 5000
+    router.submit("score", [0.0] * 4, k=4).result(timeout=5)
+    router.submit("score", [0.0] * 4, k=5000).result(timeout=5)
+    router.submit("score", [0.0] * 4).result(timeout=5)   # default k: fast
+    assert [op for op, _, _ in fast.served] == ["score", "score"]
+    assert [(op, k) for op, k, _ in big.served] == [("score", 5000)]
+
+
+def test_router_keeps_non_score_ops_off_sharded_replicas():
+    fast, big, router = _mixed_router()
+    router.submit("encode", [0.0] * 4, k=5).result(timeout=5)
+    router.submit("decode", [0.0] * 4).result(timeout=5)
+    assert big.served == []
+    assert len(fast.served) == 2
+
+
+def test_router_rejects_out_of_range_k_synchronously():
+    fast, big, router = _mixed_router()
+    for bad in (0, 5001):
+        with pytest.raises(ValueError, match="out of range"):
+            router.submit("score", [0.0] * 4, k=bad)
+    with pytest.raises(ValueError, match="integer"):
+        router.submit("score", [0.0] * 4, k=True)
+    assert router.outstanding == 0          # nothing leaked past rejection
+    assert fast.served == [] and big.served == []
+
+
+def test_router_explicit_threshold_overrides_auto():
+    fast, big, router = _mixed_router(large_k_threshold=8)
+    router.submit("score", [0.0] * 4, k=9).result(timeout=5)
+    assert [(op, k) for op, k, _ in big.served] == [("score", 9)]
+    assert fast.served == []
+
+
+def test_router_all_sharded_fleet_serves_small_k():
+    from iwae_replication_project_tpu.serving.frontend import ReplicaRouter
+
+    big = FakeReplica(sharded=True, k_max=5000, ops=("score",))
+    router = ReplicaRouter([big])
+    assert router.large_k_threshold is None
+    router.submit("score", [0.0] * 4, k=2).result(timeout=5)
+    assert [(op, k) for op, k, _ in big.served] == [("score", 2)]
+
+
+def test_router_unbounded_fast_replicas_disable_classification():
+    """Fast replicas without a k_max (RemoteEngine proxies, fakes): the
+    auto threshold must fall back to NO classification — a 0 threshold
+    would starve the fast path of every explicit-k request."""
+    from iwae_replication_project_tpu.serving.frontend import ReplicaRouter
+
+    fast = FakeReplica(sharded=False, k_max=None)
+    big = FakeReplica(sharded=True, k_max=5000, ops=("score",))
+    router = ReplicaRouter([fast, big])
+    assert router.large_k_threshold is None
+    router.submit("score", [0.0] * 4, k=5).result(timeout=5)
+    assert len(fast.served) == 1 and big.served == []
+
+
+def test_router_homogeneous_fast_fleet_keeps_old_behavior():
+    from iwae_replication_project_tpu.serving.frontend import ReplicaRouter
+
+    fasts = [FakeReplica(k_max=16) for _ in range(2)]
+    router = ReplicaRouter(fasts)
+    assert router.large_k_threshold is None
+    router.submit("score", [0.0] * 4, k=16).result(timeout=5)
+    with pytest.raises(ValueError, match="out of range"):
+        router.submit("score", [0.0] * 4, k=17)
+
+
+def test_router_large_k_with_sharded_replica_down_is_unavailable():
+    """k above the threshold with the only sharded replica unhealthy must
+    read as fleet-state (unavailable), not as a bad request."""
+    from iwae_replication_project_tpu.serving.frontend import (
+        ReplicaRouter, ReplicaUnavailable)
+
+    fast = FakeReplica(sharded=False, k_max=16)
+    big = FakeReplica(sharded=True, k_max=5000, ops=("score",))
+    router = ReplicaRouter([fast, big])
+    router._replicas[1].healthy = False
+    with pytest.raises(ReplicaUnavailable):
+        router.submit("score", [0.0] * 4, k=100)
+    # the fast path keeps serving small k
+    router.submit("score", [0.0] * 4, k=4).result(timeout=5)
+    assert len(fast.served) == 1
+
+
+# ---------------------------------------------------------------------------
+# the wire surface: typed bad_request for out-of-range k over TCP
+# ---------------------------------------------------------------------------
+
+def test_tier_typed_bad_request_for_k_over_the_wire():
+    from iwae_replication_project_tpu.serving.frontend import (
+        ServingTier, TierClient)
+    from iwae_replication_project_tpu.serving.frontend.client import (
+        TierError)
+
+    fast = FakeReplica(sharded=False, k_max=16)
+    big = FakeReplica(sharded=True, k_max=5000, ops=("score",))
+    tier = ServingTier([fast, big], monitor_interval_s=60.0).start()
+    try:
+        cli = TierClient("127.0.0.1", tier.port)
+        info = cli.info()
+        assert info["k_max"] == 5000
+        assert info["large_k_threshold"] == 16
+        assert info["sharded_replicas"] == 1
+        assert set(info["ops"]) == {"score", "encode", "decode"}
+        # buckets/k describe the FAST class even when replica order puts
+        # the sharded engine first; the sharded class gets its own sub-doc
+        # (None here: fakes carry no menu)
+        assert info["sharded"] is None
+        # valid large k routes; every invalid k is a typed bad_request
+        # RESPONSE on a live connection
+        assert cli.score([0.0] * 4, k=100) is not None
+        for bad in (0, -1, 5001, True, 2.5, "many"):
+            with pytest.raises(TierError) as ei:
+                cli.score([0.0] * 4, k=bad)
+            assert ei.value.code == "bad_request", bad
+        # the connection survived all six rejections
+        assert cli.score([0.0] * 4, k=3) is not None
+        cli.close()
+    finally:
+        tier.stop()
+
+
+def test_mixed_tier_info_describes_both_classes(devices, tiny):
+    """Real mixed fleet: info() reports the fast ladder at the top level
+    and the sharded class's menu in its own sub-doc, whatever the replica
+    order."""
+    from iwae_replication_project_tpu.serving.frontend import ServingTier
+
+    fast = ServingEngine(params=tiny["params"], model_config=CFG, k=4,
+                         k_max=16, max_batch=4)
+    big = make_sharded(tiny, make_mesh(dp=2, sp=1), max_batch=8)
+    tier = ServingTier([big, fast], monitor_interval_s=60.0)
+    try:
+        info = tier.info()
+        assert info["buckets"] == list(fast.ladder.buckets)
+        assert info["k"] == 4
+        assert info["sharded"] == {"buckets": [2, 4, 8], "k_chunk": CHUNK,
+                                   "k_max": 100, "k": 8}
+    finally:
+        tier.router.drain(timeout_s=5.0)
+
+
+def test_cli_k_split_refuses_threshold_at_or_above_k_max():
+    """--k-threshold >= --k-max with sharded replicas would make them
+    unreachable; the CLI refuses instead of wiring a dead class."""
+    from iwae_replication_project_tpu.serving.cli import (
+        _k_split, build_argparser)
+
+    args = build_argparser().parse_args(
+        ["--sharded-replicas", "1", "--k-max", "500",
+         "--k-threshold", "500"])
+    with pytest.raises(SystemExit, match="k-threshold"):
+        _k_split(args)
+    # coherent default split: threshold < k_max, both classes reachable
+    args = build_argparser().parse_args(
+        ["--sharded-replicas", "1", "--k-max", "500"])
+    fast_k_max, threshold = _k_split(args)
+    assert fast_k_max == threshold == 250
+    # explicit threshold above the engine default still tiles [1, k_max]
+    args = build_argparser().parse_args(
+        ["--sharded-replicas", "1", "--k-max", "5000",
+         "--k-threshold", "2000"])
+    assert _k_split(args) == (2000, 2000)
+
+
+def test_tier_routes_mixed_traffic_to_the_right_class():
+    from iwae_replication_project_tpu.serving.frontend import (
+        ServingTier, TierClient)
+
+    fast = FakeReplica(sharded=False, k_max=16)
+    big = FakeReplica(sharded=True, k_max=5000, ops=("score",))
+    tier = ServingTier([fast, big], monitor_interval_s=60.0).start()
+    try:
+        cli = TierClient("127.0.0.1", tier.port)
+        cli.score([0.0] * 4, k=4)
+        cli.score([0.0] * 4, k=500)
+        cli.encode([0.0] * 4)
+        cli.close()
+    finally:
+        tier.stop()
+    assert [(op, k) for op, k, _ in big.served] == [("score", 500)]
+    assert sorted(op for op, _, _ in fast.served) == ["encode", "score"]
