@@ -63,13 +63,17 @@ def _default_import_shims() -> List[str]:
 
 
 def _default_concurrency_paths() -> List[str]:
-    # files the concurrency checker (lock-order / unlocked-shared-state)
-    # analyzes: the pipelined serving engine's thread triangle (dispatcher,
-    # completion, metric scrapes) and the registry they all report through
+    # files the concurrency checker (lock-order / unlocked-shared-state /
+    # swallowed-exception) analyzes: the pipelined serving engine's thread
+    # triangle (dispatcher, completion, metric scrapes), the registry they
+    # all report through, and the fault-injection layer whose schedule
+    # state every instrumented thread mutates
     return ["iwae_replication_project_tpu/serving/engine.py",
             "iwae_replication_project_tpu/serving/batcher.py",
+            "iwae_replication_project_tpu/serving/faults.py",
             "iwae_replication_project_tpu/serving/frontend",
-            "iwae_replication_project_tpu/telemetry/registry.py"]
+            "iwae_replication_project_tpu/telemetry/registry.py",
+            "iwae_replication_project_tpu/utils/faults.py"]
 
 
 def _default_fragile_imports() -> List[str]:

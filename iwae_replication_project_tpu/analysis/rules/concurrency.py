@@ -1,5 +1,5 @@
-"""Rules ``lock-order`` and ``unlocked-shared-state``: the serving
-concurrency checker.
+"""Rules ``lock-order``, ``unlocked-shared-state``, and
+``swallowed-exception``: the serving concurrency checker.
 
 The serving engine is a three-thread system — the dispatcher coalesces and
 enqueues, the completion thread fetches and completes, and metric scrapes
@@ -30,6 +30,22 @@ Model (deliberately scoped to this codebase's locking idiom):
   it executes under any ``with self.<lock>``; an attribute with both guarded
   and bare writes outside ``__init__`` gets an ``unlocked-shared-state``
   finding at each bare site.
+
+``swallowed-exception`` adds the third failure class of a callback-driven
+serving stack: an ``except`` handler that drops the error on the floor. In
+a request/response system every exception is somebody's *outcome* — a
+future to error-complete, a typed response to write, a replica to mark
+unhealthy — and a handler that does none of that turns a failure into
+silence (the lost-future bug class the chaos harness exists to catch). A
+handler counts as HANDLING when its body re-raises, returns, breaks or
+continues (an explicit control-flow decision), or *uses the bound
+exception value* (``except X as e`` with ``e`` flowing into a completion
+call, a typed response, or a message). A deliberate best-effort drop
+(``sock.shutdown`` on teardown) carries a justified suppression — the
+inventory of intentional swallows stays reviewable in the diff.
+``contextlib.suppress(...)`` is the OTHER sanctioned idiom: it cannot
+contain logic, so it is intentional by construction (and greppable); the
+rule deliberately leaves it alone rather than demanding a second marker.
 """
 
 from __future__ import annotations
@@ -245,6 +261,49 @@ class LockOrderRule(Rule):
                         f"'{a}' here, closing the cyclic lock order "
                         f"{chain} — threads advancing around the cycle "
                         f"concurrently deadlock; pick one global order")
+
+
+def _handler_handles(handler: ast.ExceptHandler) -> bool:
+    """Whether the handler's body re-raises, makes an explicit control-flow
+    decision (return/continue/break), or uses the bound exception value —
+    the three shapes that count as handling (module docstring)."""
+    for stmt in handler.body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Raise, ast.Return, ast.Continue,
+                                 ast.Break)):
+                return True
+            if handler.name is not None and isinstance(node, ast.Name) \
+                    and node.id == handler.name \
+                    and isinstance(node.ctx, ast.Load):
+                return True
+    return False
+
+
+@register
+class SwallowedExceptionRule(Rule):
+    name = "swallowed-exception"
+    summary = ("except-and-drop in a concurrency_paths file: the handler "
+               "neither re-raises, returns/continues/breaks, nor uses the "
+               "caught exception — a dropped error is a lost future / "
+               "silent failure in a callback-driven serving stack")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _in_paths(ctx, ctx.config.concurrency_paths):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _handler_handles(node):
+                continue
+            caught = (Rule.dotted(node.type) or "...") \
+                if node.type is not None else "BaseException"
+            yield ctx.finding(
+                self.name, node,
+                f"'except {caught}' swallows the error: complete a future "
+                f"or typed response with it, re-raise, or make the drop an "
+                f"explicit control-flow decision (return/continue/break) — "
+                f"a deliberate best-effort drop needs a justified "
+                f"suppression")
 
 
 @register

@@ -9,6 +9,17 @@ scalars, checkpoint. Differences by design:
   resume-from-latest — the reference saves weights only and cannot resume;
 * eval metrics stream from single-pass kernels (evaluation/metrics.py);
 * execution is jit + optional (dp, sp) mesh sharding, selected by config.
+
+Preemption grace (``cfg.preemption_grace``, on by default): SIGTERM/SIGINT
+is absorbed into a flag (utils/faults.PreemptionGuard), the in-flight pass
+finishes, a mid-stage checkpoint is force-saved, and the run raises
+:class:`TrainingPreempted` (``main`` exits with the distinct
+:data:`PREEMPTED_EXIT_CODE` = 75, EX_TEMPFAIL — "come back with the same
+command"). The whole-epoch scan carries the RNG key, so the resumed run is
+bitwise identical to an uninterrupted one (pinned by tests and the chaos
+smoke) — including when the newest checkpoint was truncated by the kill,
+because restore falls back to the newest intact retained step
+(utils/checkpoint.py) and the deterministic replay redoes the difference.
 """
 
 from __future__ import annotations
@@ -41,12 +52,36 @@ from iwae_replication_project_tpu.utils.compile_cache import (
 from iwae_replication_project_tpu.telemetry.registry import get_registry
 from iwae_replication_project_tpu.telemetry.spans import span
 from iwae_replication_project_tpu.utils.config import ExperimentConfig
+from iwae_replication_project_tpu.utils.faults import (
+    SITE_TRAIN_PASS,
+    PreemptionGuard,
+    fault_point,
+)
 from iwae_replication_project_tpu.utils.logging import MetricsLogger
 
 #: passes fused into one dispatch for the long Burda stages; 27 = 3^3 divides
 #: every stage length >= 27 of the 3^(i-1) schedule, so stages 4-8 run
 #: entirely in blocks and only stages 1-3 (1+3+9 passes) dispatch per pass
 PASS_BLOCK = 27
+
+#: the distinct exit status of a gracefully preempted run (os.EX_TEMPFAIL:
+#: "temporary failure — try again", which is exactly the contract: re-run
+#: the same command and resume continues bitwise where the save left off)
+PREEMPTED_EXIT_CODE = 75
+
+
+class TrainingPreempted(RuntimeError):
+    """A SIGTERM/SIGINT was absorbed, the pass finished, and a mid-stage
+    checkpoint is durably saved; ``main`` maps this to
+    :data:`PREEMPTED_EXIT_CODE`."""
+
+    def __init__(self, stage: int, passes_done: int, step: int):
+        super().__init__(
+            f"preempted at stage {stage}, pass {passes_done} (step {step}); "
+            f"mid-stage checkpoint saved — resume with the same command")
+        self.stage = stage
+        self.passes_done = passes_done
+        self.step = step
 
 
 def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = None,
@@ -217,202 +252,249 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
         y_test = digits_labels()[1][:len(x_test)]
     results_history = []
 
-    for stage, lr, passes in burda_stages(cfg.n_stages, cfg.passes_scale):
-        if stage < start_stage:
-            continue
-        if logger is None and is_primary:
-            logger = MetricsLogger(cfg.log_dir, run_name=cfg.run_name())
-        state = set_learning_rate(state, lr)
-        active_spec = cfg.objective_spec(stage)
-        if is_primary:
-            print(f"stage {stage}: lr={lr:.2e}, {passes} passes, "
-                  f"objective {active_spec.name} k={active_spec.k}")
-        offset = start_offset if stage == start_stage else 0
-        done = offset          # passes completed within this stage
-        since_save = 0         # passes since the last intra-stage checkpoint
-        ckpt_s = 0.0           # seconds inside mid-stage checkpoint saves
-        stage_stats0 = cache_stats()
+    # preemption grace: SIGTERM/SIGINT -> flag; the pass boundaries below
+    # check it, force-save a mid-stage checkpoint, and raise
+    # TrainingPreempted. Inert off the main thread, and off entirely via
+    # --no-preemption-grace (guard=None restores the die-immediately
+    # behavior). The finally releases the signal handlers however the stage
+    # loop exits.
+    guard = PreemptionGuard().__enter__() if cfg.preemption_grace else None
+    try:
+        for stage, lr, passes in burda_stages(cfg.n_stages, cfg.passes_scale):
+            if stage < start_stage:
+                continue
+            if logger is None and is_primary:
+                logger = MetricsLogger(cfg.log_dir, run_name=cfg.run_name())
+            state = set_learning_rate(state, lr)
+            active_spec = cfg.objective_spec(stage)
+            if is_primary:
+                print(f"stage {stage}: lr={lr:.2e}, {passes} passes, "
+                      f"objective {active_spec.name} k={active_spec.k}")
+            offset = start_offset if stage == start_stage else 0
+            done = offset          # passes completed within this stage
+            since_save = 0         # passes since the last intra-stage checkpoint
+            ckpt_s = 0.0           # seconds inside mid-stage checkpoint saves
+            stage_stats0 = cache_stats()
 
-        def maybe_save_mid_stage():
-            # save at dispatch boundaries once >= checkpoint_every_passes
-            # passes have accumulated — but never for the final boundary,
-            # which the end-of-stage save below covers. The save (incl. its
-            # pipeline-draining fetch) is timed separately so
-            # stage_train_seconds / derived steps-per-sec stay comparable
-            # across --checkpoint-every-passes cadences (ADVICE r5).
-            nonlocal since_save, ckpt_s
-            if cfg.checkpoint_every_passes \
-                    and since_save >= cfg.checkpoint_every_passes \
-                    and done < passes:
-                t_ck = time.perf_counter()
-                save_checkpoint(ckpt_dir, int(fetch(state.step)), state, stage,
-                                config_json=cfg.to_json(),
-                                keep=cfg.checkpoint_keep, passes_done=done)
-                ckpt_s += time.perf_counter() - t_ck
-                since_save = 0
+            def maybe_save_mid_stage():
+                # save at dispatch boundaries once >= checkpoint_every_passes
+                # passes have accumulated — but never for the final boundary,
+                # which the end-of-stage save below covers. The save (incl. its
+                # pipeline-draining fetch) is timed separately so
+                # stage_train_seconds / derived steps-per-sec stay comparable
+                # across --checkpoint-every-passes cadences (ADVICE r5).
+                nonlocal since_save, ckpt_s
+                if cfg.checkpoint_every_passes \
+                        and since_save >= cfg.checkpoint_every_passes \
+                        and done < passes:
+                    t_ck = time.perf_counter()
+                    save_checkpoint(ckpt_dir, int(fetch(state.step)), state, stage,
+                                    config_json=cfg.to_json(),
+                                    keep=cfg.checkpoint_keep, passes_done=done)
+                    ckpt_s += time.perf_counter() - t_ck
+                    since_save = 0
 
-        t_train = time.perf_counter()
-        remaining = passes - offset
-        last_diag = None  # device scalars from the newest epoch dispatch
-        with span("train/stage"):
-            if remaining >= PASS_BLOCK and max_batches_per_pass is None:
-                block_fn = epoch_fn_for(active_spec, PASS_BLOCK)
-                for _ in range(remaining // PASS_BLOCK):
-                    state, out = block_fn(state, x_train_dev)
+            def pass_boundary():
+                # one call per dispatch boundary: the chaos hook (a sigterm
+                # action here is absorbed by the guard synchronously), then
+                # preemption grace — force-save the CURRENT mid-stage state
+                # and stop — then the ordinary cadence save. Grace runs
+                # before maybe_save_mid_stage so the two never write the
+                # same step twice (Orbax refuses duplicate steps).
+                fault_point(SITE_TRAIN_PASS, stage=stage, done=done)
+                if guard is not None and guard.requested and done < passes:
+                    # mid-stage only: a signal on the FINAL pass boundary
+                    # instead lets the stage finish its eval + end-of-stage
+                    # save (bounded work) and raises there — otherwise the
+                    # resume would classify the stage complete and its
+                    # metrics row / artifacts would exist in neither run
+                    step_now = int(fetch(state.step))
+                    save_checkpoint(ckpt_dir, step_now, state, stage,
+                                    config_json=cfg.to_json(),
+                                    keep=cfg.checkpoint_keep,
+                                    passes_done=done)
+                    if is_primary:
+                        print(f"preemption grace: signal {guard.signum} "
+                              f"absorbed; mid-stage checkpoint saved at "
+                              f"stage {stage}, pass {done} (step {step_now})")
+                    raise TrainingPreempted(stage, done, step_now)
+                maybe_save_mid_stage()
+
+            t_train = time.perf_counter()
+            remaining = passes - offset
+            last_diag = None  # device scalars from the newest epoch dispatch
+            with span("train/stage"):
+                if remaining >= PASS_BLOCK and max_batches_per_pass is None:
+                    block_fn = epoch_fn_for(active_spec, PASS_BLOCK)
+                    for _ in range(remaining // PASS_BLOCK):
+                        state, out = block_fn(state, x_train_dev)
+                        if diag_cfg is not None:
+                            _, last_diag = out
+                        done += PASS_BLOCK
+                        since_save += PASS_BLOCK
+                        pass_boundary()
+                    remaining = remaining % PASS_BLOCK
+                epoch_fn = epoch_fn_for(active_spec)
+                for _ in range(remaining):
+                    state, out = epoch_fn(state, x_train_dev)
                     if diag_cfg is not None:
                         _, last_diag = out
-                    done += PASS_BLOCK
-                    since_save += PASS_BLOCK
-                    maybe_save_mid_stage()
-                remaining = remaining % PASS_BLOCK
-            epoch_fn = epoch_fn_for(active_spec)
-            for _ in range(remaining):
-                state, out = epoch_fn(state, x_train_dev)
-                if diag_cfg is not None:
-                    _, last_diag = out
-                done += 1
-                since_save += 1
-                maybe_save_mid_stage()
-        # fetch forces completion of the async dispatches (np.asarray under
-        # the hood — block_until_ready only reports enqueue on remote
-        # transports), so the stage timings are honest train/eval splits
-        step_n = int(fetch(state.step))
-        train_s = time.perf_counter() - t_train
+                    done += 1
+                    since_save += 1
+                    pass_boundary()
+            # fetch forces completion of the async dispatches (np.asarray under
+            # the hood — block_until_ready only reports enqueue on remote
+            # transports), so the stage timings are honest train/eval splits
+            step_n = int(fetch(state.step))
+            train_s = time.perf_counter() - t_train
 
-        t_eval = time.perf_counter()
-        with span("eval/statistics"):
-            if mesh is not None:
-                from iwae_replication_project_tpu.parallel.eval import (
-                    parallel_training_statistics)
-                res, res2 = parallel_training_statistics(
-                    state.params, model_cfg, mesh,
-                    jax.random.fold_in(eval_key, stage),
-                    jax.numpy.asarray(x_test.reshape(len(x_test), -1)),
-                    cfg.eval_k,
-                    batch_size=min(cfg.eval_batch_size, len(x_test)),
-                    nll_k=cfg.nll_k, nll_chunk=cfg.nll_chunk,
-                    activity_samples=cfg.activity_samples)
-            else:
-                res, res2 = ev.training_statistics(
-                    state.params, model_cfg,
-                    jax.random.fold_in(eval_key, stage),
-                    jax.numpy.asarray(x_test.reshape(len(x_test), -1)),
-                    cfg.eval_k,
-                    batch_size=min(cfg.eval_batch_size, len(x_test)),
-                    nll_k=cfg.nll_k, nll_chunk=cfg.nll_chunk,
-                    activity_samples=cfg.activity_samples)
-        # estimator diagnostics (telemetry/diagnostics.py): the weight-space
-        # suite as one extra device program per eval, plus the train-side
-        # grad-SNR scalars the newest epoch dispatch carried — fetched here,
-        # with everything else, never per step. Multihost runs skip the eval
-        # program (params are not single-process-addressable; the replicated
-        # grad-SNR scalars still flow).
-        if diag_cfg is not None:
-            diag_vals = {}
-            if not cfg.multihost:
-                from iwae_replication_project_tpu.telemetry.diagnostics import (
-                    estimator_diagnostics)
-                from iwae_replication_project_tpu.utils.compile_cache import (
-                    aot_call)
-                n_eval = len(x_test)
-                ebs = ev.largest_divisor_leq(
-                    n_eval, min(cfg.eval_batch_size, n_eval))
-                ebatches = jax.numpy.asarray(
-                    x_test.reshape(n_eval // ebs, ebs, -1))
-                with span("eval/diagnostics"):
-                    diag_vals.update(fetch(aot_call(
-                        "estimator_diagnostics", estimator_diagnostics,
-                        (state.params,),
-                        kwargs=dict(key=jax.random.fold_in(eval_key,
-                                                           30_000 + stage),
-                                    batches=ebatches),
-                        static_kwargs=dict(cfg=model_cfg, k=cfg.eval_k,
-                                           diag=diag_cfg),
-                        build_key=(model_cfg, cfg.eval_k, diag_cfg))))
-            if last_diag is not None:
-                diag_vals.update(fetch(last_diag))
-            res.update({k: float(v) for k, v in diag_vals.items()})
-            reg = get_registry()
-            for k, v in diag_vals.items():
-                reg.gauge(k).set(float(v))
-        res["learning_rate"] = lr
-        res["stage"] = stage
-        # make fake-data runs unmistakable in every artifact (metrics.jsonl,
-        # results.pkl, stdout), and record which bias policy the decoder was
-        # initialized under (raw-means = the reference's fixed-bin policy)
-        res["synthetic_data"] = bool(ds.synthetic)
-        res["raw_means_bias"] = ds.bias_source == "raw"
-        res["bfloat16"] = cfg.compute_dtype == "bfloat16"
-        # wall-clock per stage (train = the passes, with mid-stage checkpoint
-        # saves broken out into stage_checkpoint_seconds so steps/s stays
-        # comparable across --checkpoint-every-passes cadences; eval = the
-        # full statistics suite), for capacity planning. After a mid-stage
-        # resume the timer only saw `passes - offset` passes —
-        # stage_passes_timed records that so steps/s derived from these
-        # fields stays honest (scripts/dress_rehearsal.py uses it).
-        res["stage_train_seconds"] = round(train_s - ckpt_s, 3)
-        res["stage_checkpoint_seconds"] = round(ckpt_s, 3)
-        # the cadence the row was produced under (0 = end-of-stage saves
-        # only), so rows from different --checkpoint-every-passes settings
-        # are identifiable when comparing derived steps/s (ADVICE r5)
-        res["checkpoint_every_passes"] = float(
-            cfg.checkpoint_every_passes or 0)
-        res["stage_passes_timed"] = float(passes - offset)
-        res["stage_eval_seconds"] = round(time.perf_counter() - t_eval, 3)
-        # warm-path accounting for THIS stage (utils/compile_cache.py): how
-        # many programs the AOT registry reused vs newly compiled, and the
-        # XLA compile seconds paid. A warm start (persistent cache populated)
-        # shows compile_cache_misses == 0 from stage 1 onward.
-        d_stats = stats_delta(stage_stats0)
-        res["aot_hits"] = float(d_stats["aot_hits"])
-        res["aot_misses"] = float(d_stats["aot_misses"])
-        res["aot_compile_seconds"] = round(d_stats["aot_compile_seconds"], 3)
-        res["compile_cache_misses"] = float(d_stats["persistent_cache_misses"])
-        res["compile_cache_hits"] = float(d_stats["persistent_cache_hits"])
-        res["compile_seconds"] = round(d_stats["backend_compile_seconds"], 3)
-        # `res` already carries "nll_chunk" — the EFFECTIVE chunk the eval
-        # driver used (clamped per device under sp) — as the eval-RNG version
-        if is_primary:
-            print({k: round(v, 4) for k, v in res.items()
-                   if isinstance(v, float)})
-        results_history.append((res, {
-            "number_of_active_units": res2["number_of_active_units"],
-            "number_of_PCA_active_units": res2["number_of_PCA_active_units"]}))
-        if logger is not None:  # primary process only under --multihost
-            # registry export (span timings, diagnostic gauges, aot counters)
-            # lands in its own runs/<run>/telemetry/ stream: metrics.jsonl
-            # keeps one row per stage — the schema every downstream consumer
-            # (plot scripts, replication driver, tests) keys on — and the
-            # telemetry stream shows up in TensorBoard as a <run>/telemetry
-            # subrun next to it
-            if diag_cfg is not None:
-                if telem_logger is None:
-                    telem_logger = MetricsLogger(logger.dir,
-                                                 run_name="telemetry")
-                telem_logger.log_registry(get_registry(), step=step_n)
-            logger.log(res, step=step_n)
-            if cfg.save_figures:
-                from iwae_replication_project_tpu.utils.viz import (
-                    save_stage_figures)
-                save_stage_figures(state.params, model_cfg,
-                                   jax.random.fold_in(eval_key, 10_000 + stage),
-                                   x_test, logger.dir, stage)
-                if y_test is not None:
-                    from iwae_replication_project_tpu.utils.viz import (
-                        latent_scatter)
-                    latent_scatter(
+            t_eval = time.perf_counter()
+            with span("eval/statistics"):
+                if mesh is not None:
+                    from iwae_replication_project_tpu.parallel.eval import (
+                        parallel_training_statistics)
+                    res, res2 = parallel_training_statistics(
+                        state.params, model_cfg, mesh,
+                        jax.random.fold_in(eval_key, stage),
+                        jax.numpy.asarray(x_test.reshape(len(x_test), -1)),
+                        cfg.eval_k,
+                        batch_size=min(cfg.eval_batch_size, len(x_test)),
+                        nll_k=cfg.nll_k, nll_chunk=cfg.nll_chunk,
+                        activity_samples=cfg.activity_samples)
+                else:
+                    res, res2 = ev.training_statistics(
                         state.params, model_cfg,
-                        jax.random.fold_in(eval_key, 20_000 + stage),
-                        x_test, os.path.join(logger.dir, "figures",
-                                             f"stage_{stage:02d}_latent.png"),
-                        labels=y_test)
-            with open(os.path.join(logger.dir, "results.pkl"), "wb") as f:
-                pickle.dump(results_history, f)
+                        jax.random.fold_in(eval_key, stage),
+                        jax.numpy.asarray(x_test.reshape(len(x_test), -1)),
+                        cfg.eval_k,
+                        batch_size=min(cfg.eval_batch_size, len(x_test)),
+                        nll_k=cfg.nll_k, nll_chunk=cfg.nll_chunk,
+                        activity_samples=cfg.activity_samples)
+            # estimator diagnostics (telemetry/diagnostics.py): the weight-space
+            # suite as one extra device program per eval, plus the train-side
+            # grad-SNR scalars the newest epoch dispatch carried — fetched here,
+            # with everything else, never per step. Multihost runs skip the eval
+            # program (params are not single-process-addressable; the replicated
+            # grad-SNR scalars still flow).
+            if diag_cfg is not None:
+                diag_vals = {}
+                if not cfg.multihost:
+                    from iwae_replication_project_tpu.telemetry.diagnostics import (
+                        estimator_diagnostics)
+                    from iwae_replication_project_tpu.utils.compile_cache import (
+                        aot_call)
+                    n_eval = len(x_test)
+                    ebs = ev.largest_divisor_leq(
+                        n_eval, min(cfg.eval_batch_size, n_eval))
+                    ebatches = jax.numpy.asarray(
+                        x_test.reshape(n_eval // ebs, ebs, -1))
+                    with span("eval/diagnostics"):
+                        diag_vals.update(fetch(aot_call(
+                            "estimator_diagnostics", estimator_diagnostics,
+                            (state.params,),
+                            kwargs=dict(key=jax.random.fold_in(eval_key,
+                                                               30_000 + stage),
+                                        batches=ebatches),
+                            static_kwargs=dict(cfg=model_cfg, k=cfg.eval_k,
+                                               diag=diag_cfg),
+                            build_key=(model_cfg, cfg.eval_k, diag_cfg))))
+                if last_diag is not None:
+                    diag_vals.update(fetch(last_diag))
+                res.update({k: float(v) for k, v in diag_vals.items()})
+                reg = get_registry()
+                for k, v in diag_vals.items():
+                    reg.gauge(k).set(float(v))
+            res["learning_rate"] = lr
+            res["stage"] = stage
+            # make fake-data runs unmistakable in every artifact (metrics.jsonl,
+            # results.pkl, stdout), and record which bias policy the decoder was
+            # initialized under (raw-means = the reference's fixed-bin policy)
+            res["synthetic_data"] = bool(ds.synthetic)
+            res["raw_means_bias"] = ds.bias_source == "raw"
+            res["bfloat16"] = cfg.compute_dtype == "bfloat16"
+            # wall-clock per stage (train = the passes, with mid-stage checkpoint
+            # saves broken out into stage_checkpoint_seconds so steps/s stays
+            # comparable across --checkpoint-every-passes cadences; eval = the
+            # full statistics suite), for capacity planning. After a mid-stage
+            # resume the timer only saw `passes - offset` passes —
+            # stage_passes_timed records that so steps/s derived from these
+            # fields stays honest (scripts/dress_rehearsal.py uses it).
+            res["stage_train_seconds"] = round(train_s - ckpt_s, 3)
+            res["stage_checkpoint_seconds"] = round(ckpt_s, 3)
+            # the cadence the row was produced under (0 = end-of-stage saves
+            # only), so rows from different --checkpoint-every-passes settings
+            # are identifiable when comparing derived steps/s (ADVICE r5)
+            res["checkpoint_every_passes"] = float(
+                cfg.checkpoint_every_passes or 0)
+            res["stage_passes_timed"] = float(passes - offset)
+            res["stage_eval_seconds"] = round(time.perf_counter() - t_eval, 3)
+            # warm-path accounting for THIS stage (utils/compile_cache.py): how
+            # many programs the AOT registry reused vs newly compiled, and the
+            # XLA compile seconds paid. A warm start (persistent cache populated)
+            # shows compile_cache_misses == 0 from stage 1 onward.
+            d_stats = stats_delta(stage_stats0)
+            res["aot_hits"] = float(d_stats["aot_hits"])
+            res["aot_misses"] = float(d_stats["aot_misses"])
+            res["aot_compile_seconds"] = round(d_stats["aot_compile_seconds"], 3)
+            res["compile_cache_misses"] = float(d_stats["persistent_cache_misses"])
+            res["compile_cache_hits"] = float(d_stats["persistent_cache_hits"])
+            res["compile_seconds"] = round(d_stats["backend_compile_seconds"], 3)
+            # `res` already carries "nll_chunk" — the EFFECTIVE chunk the eval
+            # driver used (clamped per device under sp) — as the eval-RNG version
+            if is_primary:
+                print({k: round(v, 4) for k, v in res.items()
+                       if isinstance(v, float)})
+            results_history.append((res, {
+                "number_of_active_units": res2["number_of_active_units"],
+                "number_of_PCA_active_units": res2["number_of_PCA_active_units"]}))
+            if logger is not None:  # primary process only under --multihost
+                # registry export (span timings, diagnostic gauges, aot counters)
+                # lands in its own runs/<run>/telemetry/ stream: metrics.jsonl
+                # keeps one row per stage — the schema every downstream consumer
+                # (plot scripts, replication driver, tests) keys on — and the
+                # telemetry stream shows up in TensorBoard as a <run>/telemetry
+                # subrun next to it
+                if diag_cfg is not None:
+                    if telem_logger is None:
+                        telem_logger = MetricsLogger(logger.dir,
+                                                     run_name="telemetry")
+                    telem_logger.log_registry(get_registry(), step=step_n)
+                logger.log(res, step=step_n)
+                if cfg.save_figures:
+                    from iwae_replication_project_tpu.utils.viz import (
+                        save_stage_figures)
+                    save_stage_figures(state.params, model_cfg,
+                                       jax.random.fold_in(eval_key, 10_000 + stage),
+                                       x_test, logger.dir, stage)
+                    if y_test is not None:
+                        from iwae_replication_project_tpu.utils.viz import (
+                            latent_scatter)
+                        latent_scatter(
+                            state.params, model_cfg,
+                            jax.random.fold_in(eval_key, 20_000 + stage),
+                            x_test, os.path.join(logger.dir, "figures",
+                                                 f"stage_{stage:02d}_latent.png"),
+                            labels=y_test)
+                with open(os.path.join(logger.dir, "results.pkl"), "wb") as f:
+                    pickle.dump(results_history, f)
 
-        # every process participates: Orbax coordinates multi-host saves
-        save_checkpoint(ckpt_dir, step_n, state, stage,
-                        config_json=cfg.to_json(), keep=cfg.checkpoint_keep)
+            # every process participates: Orbax coordinates multi-host saves
+            save_checkpoint(ckpt_dir, step_n, state, stage,
+                            config_json=cfg.to_json(), keep=cfg.checkpoint_keep)
+            if guard is not None and guard.requested:
+                # the signal landed during this stage's tail (final pass
+                # boundary, eval, or artifact writes): the stage is now
+                # complete AND durably saved — stop here, resume continues
+                # at the next stage
+                if is_primary:
+                    print(f"preemption grace: signal {guard.signum} "
+                          f"absorbed; stage {stage} completed and saved "
+                          f"(step {step_n})")
+                raise TrainingPreempted(stage, passes, step_n)
 
+    finally:
+        if guard is not None:
+            guard.__exit__(None, None, None)
     if telem_logger is not None:
         telem_logger.close()
     if logger is not None:
@@ -476,7 +558,14 @@ def _run_experiment_eager(cfg: ExperimentConfig,
 def main(argv=None):
     from iwae_replication_project_tpu.utils.config import config_from_args
     cfg = config_from_args(argv)
-    run_experiment(cfg)
+    try:
+        run_experiment(cfg)
+    except TrainingPreempted as e:
+        # the distinct preemption exit: schedulers (and humans) distinguish
+        # "resume me" from a crash, and the saved mid-stage checkpoint makes
+        # re-running the same command continue bitwise
+        print(f"exiting {PREEMPTED_EXIT_CODE} (preempted): {e}")
+        raise SystemExit(PREEMPTED_EXIT_CODE) from None
 
 
 if __name__ == "__main__":

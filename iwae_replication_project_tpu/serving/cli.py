@@ -145,6 +145,22 @@ def build_argparser() -> argparse.ArgumentParser:
                       default=None,
                       help="client mode: the quota principal stamped on "
                            "requests")
+    tier.add_argument("--retries", type=int, default=0,
+                      help="client mode: RETRIES per request after the "
+                           "first attempt (reconnect + typed retryable "
+                           "errors with decorrelated-jitter backoff, "
+                           "honoring the tier's retry_after_s hints; "
+                           "0 = off, the raw one-shot client)")
+    tier.add_argument("--hedge-after-s", dest="hedge_after_s", type=float,
+                      default=None,
+                      help="client mode: tail-latency hedge — re-send a "
+                           "request unanswered after this many seconds on "
+                           "a second connection, first response wins "
+                           "(needs --retries >= 1)")
+    tier.add_argument("--retry-deadline-s", dest="retry_deadline_s",
+                      type=float, default=30.0,
+                      help="client mode: overall wall budget per request "
+                           "across retries and hedges")
     tier.add_argument("--k-sweep", dest="k_sweep", type=str, default=None,
                       metavar="K1,K2,...",
                       help="client mode: score-only load that cycles "
@@ -398,11 +414,19 @@ def _client_mode(args) -> int:
     """``--client HOST:PORT``: drive a running tier over TCP."""
     import numpy as np
 
-    from iwae_replication_project_tpu.serving.frontend import TierClient
+    from iwae_replication_project_tpu.serving.frontend import (
+        RetryPolicy, TierClient)
 
+    retry = None
+    if args.retries > 0:
+        # the flag counts RETRIES; the policy counts total attempts
+        retry = RetryPolicy(max_attempts=args.retries + 1,
+                            deadline_s=args.retry_deadline_s,
+                            hedge_after_s=args.hedge_after_s,
+                            seed=args.seed)
     host, _, port = args.client.rpartition(":")
     cli = TierClient(host or "127.0.0.1", int(port),
-                     client_id=args.client_id)
+                     client_id=args.client_id, retry=retry)
     if args.interactive:
         _client_interactive(cli)
         cli.close()

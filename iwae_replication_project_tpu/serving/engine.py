@@ -64,6 +64,11 @@ from iwae_replication_project_tpu.serving.buckets import (
     as_rows,
     validate_k,
 )
+from iwae_replication_project_tpu.serving.faults import (
+    SITE_ENGINE_FETCH,
+    SITE_ENGINE_LAUNCH,
+    fault_point,
+)
 from iwae_replication_project_tpu.serving.metrics import ServingMetrics
 from iwae_replication_project_tpu.serving.programs import PROGRAMS
 
@@ -432,6 +437,10 @@ class ServingEngine:
 
         op, k = batch[0].group
         n = len(batch)
+        # chaos hook (utils/faults.py; off = one None check): a raise here
+        # is the replica-crash signal — it propagates into _launch_routed
+        # and lands in exactly this batch's futures
+        fault_point(SITE_ENGINE_LAUNCH, engine=self, op=op, k=k, batch=n)
         bucket = self.ladder.bucket_for(n)
         payload = self.ladder.pad_rows(
             np.stack([r.payload for r in batch]), bucket)
@@ -486,6 +495,10 @@ class ServingEngine:
         try:
             with span(f"serve/complete/{inf.op}",
                       registry=self.metrics.registry):
+                # chaos hook: a raise here models a deferred device failure
+                # — routed to exactly this batch's futures below (ctx
+                # carries op, matching serving/faults.py's site table)
+                fault_point(SITE_ENGINE_FETCH, engine=self, op=inf.op)
                 out = self._fetch(inf.out)
         except Exception as e:
             for r in inf.batch:

@@ -1,0 +1,151 @@
+"""Serving-layer fault sites and chaos rule builders.
+
+The serving stack's :func:`~..utils.faults.fault_point` hooks fire at
+these sites (names are the schedule's addressing scheme — a typo'd site
+matches nothing, so the constants below are the one spelling):
+
+====================  =====================================================
+site                  where / ctx kwargs
+====================  =====================================================
+SITE_ENGINE_LAUNCH    ServingEngine._launch, before the AOT enqueue
+                      (``engine``, ``op``, ``k``, ``batch``) — a raise
+                      lands in exactly that batch's futures, the signal
+                      surface of a replica crash
+SITE_ENGINE_FETCH     the completion stage's device->host fetch
+                      (``engine``, ``op``) — deferred device failure
+SITE_ROUTER_DISPATCH  ReplicaRouter._dispatch, inside the per-replica
+                      submit try (``router``, ``replica``, ``attempt``)
+SITE_TIER_WRITE       tier connection response write, under the
+                      connection lock before ``sendall`` (``sock``,
+                      ``conn``) — where dropped/garbled TCP lives
+SITE_REMOTE_SEND      RemoteEngine.submit, inside the send try
+                      (``addr``) — an OSError here poisons the proxy
+====================  =====================================================
+
+plus the generic sites defined in utils/faults.py (``aot.call_async``,
+``train.pass``, ``train.checkpoint.save``). The builders below wrap the
+common chaos cases as one-liner rules; anything they don't cover composes
+from :class:`~..utils.faults.FaultRule` directly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import socket
+
+from iwae_replication_project_tpu.utils.faults import (  # noqa: F401
+    SITE_AOT_CALL_ASYNC,
+    SITE_CKPT_SAVE,
+    SITE_TRAIN_PASS,
+    FaultContext,
+    FaultInjected,
+    FaultRule,
+    FaultSchedule,
+    clear,
+    delay,
+    fault_point,
+    install,
+    installed,
+    raise_error,
+    raise_fault,
+    sigterm,
+)
+
+__all__ = [
+    "SITE_ENGINE_LAUNCH", "SITE_ENGINE_FETCH", "SITE_ROUTER_DISPATCH",
+    "SITE_TIER_WRITE", "SITE_REMOTE_SEND",
+    "crash_replica", "slow_replica", "drop_tier_connection",
+    "garble_tier_connection", "crash_aot_dispatch", "sever_remote",
+]
+
+SITE_ENGINE_LAUNCH = "serve.engine.launch"
+SITE_ENGINE_FETCH = "serve.engine.fetch"
+SITE_ROUTER_DISPATCH = "serve.router.dispatch"
+SITE_TIER_WRITE = "serve.tier.write"
+SITE_REMOTE_SEND = "serve.remote.send"
+
+
+def _is_engine(engine) -> "callable":
+    return lambda ctx: ctx.get("engine") is engine
+
+
+def crash_replica(engine, after: int = 0, times=None,
+                  name: str = "crash_replica") -> FaultRule:
+    """Raise from `engine`'s dispatch path after `after` launches: the
+    batch's futures error, the router marks the replica unhealthy and
+    reroutes its outstanding work with the original seeds. ``times=None``
+    keeps the replica down (re-admission probes keep failing) until the
+    schedule is cleared; a finite ``times`` models a transient crash."""
+    return FaultRule(site=SITE_ENGINE_LAUNCH, after=after, times=times,
+                     match=_is_engine(engine), name=name,
+                     action=raise_fault("replica crash (chaos)"))
+
+
+def slow_replica(engine, delay_s: float, after: int = 0, times=1,
+                 name: str = "slow_replica") -> FaultRule:
+    """Stall `engine`'s dispatcher for `delay_s` on one (or `times`)
+    launches — the tail-latency fault that client hedging exists for."""
+    return FaultRule(site=SITE_ENGINE_LAUNCH, after=after, times=times,
+                     match=_is_engine(engine), name=name,
+                     action=delay(delay_s))
+
+
+def _kill_sock(fc: FaultContext) -> None:
+    sock_ = fc.ctx.get("sock")
+    if sock_ is not None:
+        with contextlib.suppress(OSError):
+            sock_.shutdown(socket.SHUT_RDWR)
+        with contextlib.suppress(OSError):
+            sock_.close()
+
+
+def drop_tier_connection(after: int = 0, times=1,
+                         name: str = "drop_connection") -> FaultRule:
+    """Close the client connection under the tier's response write: the
+    response is produced but never delivered — the client sees a dead
+    socket mid-request and must reconnect + retry. The action only touches
+    the socket (never raises), so the server's own OSError handling runs
+    exactly as it would for a real peer reset."""
+    return FaultRule(site=SITE_TIER_WRITE, after=after, times=times,
+                     name=name, action=_kill_sock)
+
+
+def _garble_sock(fc: FaultContext) -> None:
+    sock_ = fc.ctx.get("sock")
+    if sock_ is not None:
+        with contextlib.suppress(OSError):
+            # not JSON, not even UTF-8: the client's framed reader must
+            # surface a ProtocolError, not limp along
+            sock_.sendall(b"\xff\xfe{garbled" + b"\n")
+
+
+def garble_tier_connection(after: int = 0, times=1,
+                           name: str = "garble_connection") -> FaultRule:
+    """Interpose garbage bytes on the wire before a response line (fired
+    under the connection's write lock, so the garbage is frame-aligned and
+    the run is deterministic): the client reads a malformed frame and must
+    treat the connection as poisoned."""
+    return FaultRule(site=SITE_TIER_WRITE, after=after, times=times,
+                     name=name, action=_garble_sock)
+
+
+def crash_aot_dispatch(after: int = 0, times=1, program_prefix: str = "serve_",
+                       name: str = "crash_aot") -> FaultRule:
+    """Raise inside ``aot_call_async`` for matching programs — the
+    enqueue-time failure class (OOM, poisoned runtime) that must land in
+    exactly the affected batch's futures, never kill a dispatcher thread."""
+    return FaultRule(
+        site=SITE_AOT_CALL_ASYNC, after=after, times=times, name=name,
+        match=lambda ctx: str(ctx.get("name", "")).startswith(program_prefix),
+        action=raise_fault("AOT dispatch failure (chaos)"))
+
+
+def sever_remote(after: int = 0, times=1,
+                 name: str = "sever_remote") -> FaultRule:
+    """Raise ``OSError`` from RemoteEngine's socket send: the proxy poisons
+    itself, outstanding futures fail typed, and (under a RetryPolicy) the
+    next submit attempts a fresh connection."""
+    return FaultRule(site=SITE_REMOTE_SEND, after=after, times=times,
+                     name=name,
+                     action=raise_error(
+                         lambda fc: OSError("connection severed (chaos)")))

@@ -28,7 +28,11 @@ Module map — one concern per file, every policy unit-testable with fakes:
   smoke scripts, benches);
 * ``remote.py`` — a running tier wrapped back into the engine surface
   (``RemoteEngine``), so a parent router composes fleets out of processes
-  (the ``replica_scaling`` bench) and recursively out of fleets.
+  (the ``replica_scaling`` bench) and recursively out of fleets;
+* ``retry.py`` — :class:`RetryPolicy`: exponential backoff with
+  decorrelated jitter, per-code retryability, deadlines, ``retry_after_s``
+  hints, and tail-latency hedging — the client half of the failure model,
+  consumed by ``TierClient(retry=...)`` and ``RemoteEngine(retry=...)``.
 
 Per-request semantics are unchanged from the single engine: requests are
 scored with k-sample IWAE log p̂(x) (arXiv:1509.00519), seeds are minted at
@@ -48,6 +52,7 @@ from iwae_replication_project_tpu.serving.frontend.quotas import (
     QuotaPolicy,
 )
 from iwae_replication_project_tpu.serving.frontend.remote import RemoteEngine
+from iwae_replication_project_tpu.serving.frontend.retry import RetryPolicy
 from iwae_replication_project_tpu.serving.frontend.router import (
     ReplicaRouter,
     ReplicaUnavailable,
@@ -56,5 +61,6 @@ from iwae_replication_project_tpu.serving.frontend.router import (
 from iwae_replication_project_tpu.serving.frontend.server import ServingTier
 
 __all__ = ["ServingTier", "ReplicaRouter", "TierClient", "RemoteEngine",
-           "ClientQuotas", "QuotaPolicy", "QuotaExceeded", "TierOverloaded",
-           "ReplicaUnavailable", "ERROR_CODES", "error_code_for"]
+           "RetryPolicy", "ClientQuotas", "QuotaPolicy", "QuotaExceeded",
+           "TierOverloaded", "ReplicaUnavailable", "ERROR_CODES",
+           "error_code_for"]

@@ -16,29 +16,50 @@ the client performs no array conversion, so callers choose their own
 container (and this module stays clean under the serving host-sync lint,
 which covers serving/frontend/).
 
-The client is intentionally single-threaded: reads happen on the calling
-thread inside ``request``/``drain``. One client = one connection = one
-in-order request stream; run several clients for concurrency (the bench
-and smoke do).
+**Self-healing** (``retry=RetryPolicy(...)``): the blocking path becomes
+the failure model's last hop — typed retryable errors back off (honoring
+the response's ``retry_after_s`` hint) and resend; a dropped or garbled
+connection reconnects first; and with ``hedge_after_s`` set, a request
+unanswered past the hedge delay is re-sent on a second connection,
+first response wins, the loser's connection is closed. Retrying is safe
+because serving results are a pure function of (weights, payload, seed,
+k): a caller that pins an explicit ``seed`` gets the bitwise-identical
+result on any attempt, any replica, any connection — the chaos smoke's
+parity proof. The pipelined API stays raw by design (ids are per
+connection; a reconnect abandons unread pipelined responses), and
+:attr:`retry_stats` counts retries/reconnects/hedges for smoke
+accounting.
+
+The client is intentionally single-threaded except during a hedge race:
+reads happen on the calling thread inside ``request``/``drain``. One
+client = one connection = one in-order request stream; run several
+clients for concurrency (the bench and smoke do).
 """
 
 from __future__ import annotations
 
+import queue as _queue
 import socket
+import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from iwae_replication_project_tpu.serving.frontend import protocol
+from iwae_replication_project_tpu.serving.frontend.retry import RetryPolicy
 
 __all__ = ["TierClient", "TierError"]
 
 
 class TierError(RuntimeError):
     """A typed error response from the tier (``code`` is one of
-    :data:`~.protocol.ERROR_CODES`)."""
+    :data:`~.protocol.ERROR_CODES`; ``retry_after_s`` is the response's
+    optional machine-readable back-off hint)."""
 
-    def __init__(self, code: str, message: str):
+    def __init__(self, code: str, message: str,
+                 retry_after_s: Optional[float] = None):
         super().__init__(f"[{code}] {message}")
         self.code = code
+        self.retry_after_s = retry_after_s
 
 
 class TierClient:
@@ -46,23 +67,66 @@ class TierClient:
 
     def __init__(self, host: str, port: int, *,
                  client_id: Optional[str] = None,
-                 timeout_s: Optional[float] = 60.0):
+                 timeout_s: Optional[float] = 60.0,
+                 retry: Optional[RetryPolicy] = None):
         self.client_id = client_id
-        self._sock = socket.create_connection((host, port),
-                                              timeout=timeout_s)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._reader = protocol.LineReader(self._sock)
+        self._host = host
+        self._port = port
+        self._timeout_s = timeout_s
+        self._retry = retry
         self._next_id = 0
+        self._retry_streams = 0
         #: id -> response, for replies read while waiting on another id
         self._responses: Dict[int, Dict[str, Any]] = {}
+        #: self-healing accounting (the chaos smoke's evidence)
+        self.retry_stats = {"retries": 0, "reconnects": 0, "hedges": 0,
+                            "hedge_wins": 0}
+        self._sock: Optional[socket.socket] = None
+        self._reader: Optional[protocol.LineReader] = None
+        self._closed = False
+        self._connect()
+
+    # -- connection lifecycle -----------------------------------------------
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection((self._host, self._port),
+                                              timeout=self._timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._reader = protocol.LineReader(self._sock)
+        # wire ids are per connection: responses buffered from a previous
+        # connection can never be matched again
+        self._responses = {}
+
+    def _disconnect(self) -> None:
+        sock, self._sock, self._reader = self._sock, None, None
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:  # iwaelint: disable=swallowed-exception -- best-effort shutdown of a possibly already-dead socket; close() below is the real teardown
+                pass
+            sock.close()
+
+    def _ensure_connected(self) -> None:
+        if self._closed:
+            # close() is final: a silent re-dial would turn use-after-close
+            # into a leaked socket instead of an error
+            raise ConnectionError("client is closed")
+        if self._sock is None:
+            self._connect()
+            self.retry_stats["reconnects"] += 1
 
     # -- pipelined API ------------------------------------------------------
 
     def submit(self, op: str, x, k: Optional[int] = None,
                seed: Optional[int] = None) -> int:
         """Send one request without waiting; returns its wire id. ``seed``
-        (single-row payloads only) is the fleet-composition hook — see
-        protocol.py; ordinary callers leave it unset."""
+        (single-row payloads only) pins the row's RNG stream — the
+        fleet-composition AND retry-parity hook (see protocol.py);
+        ordinary non-retrying callers leave it unset."""
+        if self._sock is None:
+            raise ConnectionError("client is disconnected (a prior "
+                                  "connection failure); blocking requests "
+                                  "under a RetryPolicy reconnect themselves")
         self._next_id += 1
         req_id = self._next_id
         req: Dict[str, Any] = {"id": req_id, "op": op, "x": x}
@@ -90,7 +154,8 @@ class TierClient:
         resp = self._responses.pop(req_id)
         if not resp.get("ok"):
             raise TierError(resp.get("error", "internal"),
-                            resp.get("message", ""))
+                            resp.get("message", ""),
+                            retry_after_s=resp.get("retry_after_s"))
         return resp["result"]
 
     def drain(self, req_ids: List[int]) -> Dict[int, Dict[str, Any]]:
@@ -114,20 +179,159 @@ class TierClient:
 
     # -- blocking API -------------------------------------------------------
 
-    def request(self, op: str, x, k: Optional[int] = None) -> List[Any]:
-        return self.wait(self.submit(op, x, k=k))
+    def request(self, op: str, x, k: Optional[int] = None,
+                seed: Optional[int] = None) -> List[Any]:
+        if self._retry is None:
+            return self.wait(self.submit(op, x, k=k, seed=seed))
+        return self._request_retrying(op, x, k, seed)
 
-    def score(self, x, k: Optional[int] = None) -> List[Any]:
+    def score(self, x, k: Optional[int] = None,
+              seed: Optional[int] = None) -> List[Any]:
         """Per-row k-sample IWAE log p̂(x) (list of floats)."""
-        return self.request("score", x, k=k)
+        return self.request("score", x, k=k, seed=seed)
 
-    def encode(self, x, k: Optional[int] = None) -> List[Any]:
-        return self.request("encode", x, k=k)
+    def encode(self, x, k: Optional[int] = None,
+               seed: Optional[int] = None) -> List[Any]:
+        return self.request("encode", x, k=k, seed=seed)
 
-    def decode(self, h) -> List[Any]:
-        return self.request("decode", h)
+    def decode(self, h, seed: Optional[int] = None) -> List[Any]:
+        return self.request("decode", h, seed=seed)
+
+    # -- retry/hedging machinery (blocking path only) -----------------------
+
+    def _request_retrying(self, op: str, x, k: Optional[int],
+                          seed: Optional[int]) -> List[Any]:
+        """The RetryPolicy loop: reconnect + resend across connection
+        failures, back off and resend on typed retryable errors, give up
+        at max_attempts or the overall deadline — whichever first. Raises
+        the LAST failure unchanged (typed TierError, or the connection
+        error) so callers keep the real diagnosis."""
+        policy = self._retry
+        self._retry_streams += 1
+        backoff = policy.backoff(self._retry_streams)
+        deadline = None if policy.deadline_s is None \
+            else time.monotonic() + policy.deadline_s
+        last: Optional[BaseException] = None
+        for attempt in range(1, policy.max_attempts + 1):
+            hint = None
+            try:
+                self._ensure_connected()
+                rid = self.submit(op, x, k=k, seed=seed)
+                return self._await(rid, op, x, k, seed, deadline)
+            except TierError as e:
+                if not policy.retryable(e.code) or (
+                        e.code == "quota_exceeded"
+                        and e.retry_after_s is None):
+                    # a quota rejection WITHOUT a refill hint is the
+                    # cost-above-burst case: no wait can ever admit it —
+                    # the request must be split, not re-sent
+                    raise
+                last, hint = e, e.retry_after_s
+            except (OSError, protocol.ProtocolError) as e:
+                if self._closed:
+                    raise       # use-after-close is an error, not a retry
+                # dropped (OSError/ConnectionError) or garbled
+                # (ProtocolError) connection: the stream is unusable —
+                # reconnect before the next attempt
+                self._disconnect()
+                if not policy.retry_connection_errors:
+                    raise
+                last = e
+            if attempt >= policy.max_attempts:
+                break
+            sleep_s = backoff.next_delay(hint)
+            if deadline is not None and \
+                    time.monotonic() + sleep_s > deadline:
+                break
+            self.retry_stats["retries"] += 1
+            time.sleep(sleep_s)
+        raise last
+
+    def _await(self, rid: int, op: str, x, k, seed,
+               deadline: Optional[float]) -> List[Any]:
+        """Wait for `rid`, hedging to a second connection when the policy
+        asks for it and the primary is slow."""
+        policy = self._retry
+        if policy.hedge_after_s is None:
+            return self.wait(rid)
+        # phase 1: give the primary hedge_after_s to answer (socket
+        # timeout — partial frames stay buffered in the LineReader)
+        self._sock.settimeout(policy.hedge_after_s)
+        try:
+            return self.wait(rid)
+        except socket.timeout:  # iwaelint: disable=swallowed-exception -- the timeout IS the hedge trigger: a slow (not dead) primary falls through to the two-connection race below
+            pass
+        finally:
+            if self._sock is not None:
+                self._sock.settimeout(self._timeout_s)
+        # phase 2: second connection, same request, SAME seed (bitwise-
+        # identical answer); two waiter threads race into one queue
+        self.retry_stats["hedges"] += 1
+        finished = set()
+        primary_broken = False
+        hedge = TierClient(self._host, self._port, client_id=self.client_id,
+                           timeout_s=self._timeout_s)
+        # everything past the hedge dial runs under the finally that closes
+        # it: a submit that dies on a freshly-reset connection must not
+        # leak the hedge socket (nor skip the primary cleanup decision)
+        try:
+            hrid = hedge.submit(op, x, k=k, seed=seed)
+            results: "_queue.Queue" = _queue.Queue()
+
+            def waiter(tag: str, cli: "TierClient", r: int) -> None:
+                try:
+                    results.put((tag, None, cli.wait(r)))
+                except BaseException as e:
+                    results.put((tag, e, None))
+
+            for tag, cli, r in (("primary", self, rid),
+                                ("hedge", hedge, hrid)):
+                threading.Thread(target=waiter, args=(tag, cli, r),
+                                 daemon=True).start()
+            tag, err, value = self._race(results, deadline)
+            finished.add(tag)
+            primary_broken |= tag == "primary" and \
+                isinstance(err, (OSError, protocol.ProtocolError))
+            if err is None:
+                if tag == "hedge":
+                    self.retry_stats["hedge_wins"] += 1
+                return value
+            # the first finisher failed; the slower leg may still win —
+            # wait it out within the deadline, else surface the error
+            tag2, err2, value2 = self._race(results, deadline)
+            finished.add(tag2)
+            primary_broken |= tag2 == "primary" and \
+                isinstance(err2, (OSError, protocol.ProtocolError))
+            if err2 is None:
+                if tag2 == "hedge":
+                    self.retry_stats["hedge_wins"] += 1
+                return value2
+            raise err
+        finally:
+            # first-wins cancellation: the hedge connection is throwaway
+            # (closing it unblocks its waiter; the tier's write to a closed
+            # socket is dropped server-side), and the primary is abandoned
+            # too when a waiter may still be blocked on it — or when its
+            # stream broke. It reconnects lazily on the next request.
+            hedge.close()
+            if "primary" not in finished or primary_broken:
+                self._disconnect()
+
+    @staticmethod
+    def _race(results: "_queue.Queue", deadline: Optional[float]):
+        timeout = None if deadline is None \
+            else max(0.0, deadline - time.monotonic())
+        try:
+            return results.get(timeout=timeout)
+        except _queue.Empty:
+            raise TierError(
+                "timeout", "request deadline passed while hedging "
+                "(neither connection answered)") from None
+
+    # -- control ops --------------------------------------------------------
 
     def _control(self, op: str) -> Dict[str, Any]:
+        self._ensure_connected()
         self._next_id += 1
         self._sock.sendall(protocol.encode_line(
             {"id": self._next_id, "op": op}))
@@ -143,11 +347,8 @@ class TierClient:
         return self._control("stats")
 
     def close(self) -> None:
-        try:
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        self._sock.close()
+        self._closed = True
+        self._disconnect()
 
     def __enter__(self) -> "TierClient":
         return self

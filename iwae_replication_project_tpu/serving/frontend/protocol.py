@@ -33,7 +33,15 @@ ceiling.
 Response object::
 
     {"id": ..., "ok": true,  "result": [..per-row results..]}
-    {"id": ..., "ok": false, "error": "<code>", "message": "..."}
+    {"id": ..., "ok": false, "error": "<code>", "message": "...",
+     "retry_after_s": 0.25}                    # optional, machine-readable
+
+``retry_after_s`` is the back-off hint for retryable rejections:
+``quota_exceeded`` carries the client's exact token-refill wait (computed
+by quotas.py), ``overloaded`` the tier's configured shed hint — so a
+:class:`~.retry.RetryPolicy` sleeps precisely instead of guessing. Absent
+on errors where waiting cannot help (``bad_request``, a cost above the
+quota burst).
 
 Error codes (``ERROR_CODES``) are the tier's failure model, one code per
 admission/serving outcome — a rejected request is a typed *response*, never
@@ -100,10 +108,16 @@ def ok_response(req_id: Any, result) -> Dict[str, Any]:
     return {"id": req_id, "ok": True, "result": result}
 
 
-def error_response(req_id: Any, code: str, message: str) -> Dict[str, Any]:
+def error_response(req_id: Any, code: str, message: str,
+                   retry_after_s: Optional[float] = None) -> Dict[str, Any]:
     if code not in ERROR_CODES:
         code = "internal"
-    return {"id": req_id, "ok": False, "error": code, "message": message}
+    resp = {"id": req_id, "ok": False, "error": code, "message": message}
+    if retry_after_s is not None:
+        # machine-readable back-off hint (module docstring): only ever a
+        # non-negative float, so clients can trust it as a sleep argument
+        resp["retry_after_s"] = max(0.0, float(retry_after_s))
+    return resp
 
 
 def error_code_for(exc: BaseException) -> str:

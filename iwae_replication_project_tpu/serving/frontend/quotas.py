@@ -31,7 +31,17 @@ DEFAULT_CLIENT = "anonymous"
 
 
 class QuotaExceeded(RuntimeError):
-    """The client's token bucket cannot cover the request; retry later."""
+    """The client's token bucket cannot cover the request; retry later.
+
+    ``retry_after_s`` is the exact wait until the bucket covers the cost
+    (None when no wait can help — a cost above burst capacity). The front
+    end surfaces it as the response's machine-readable ``retry_after_s``
+    field and :class:`~.retry.RetryPolicy` honors it as a back-off floor.
+    """
+
+    def __init__(self, message: str, retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,7 +114,8 @@ class ClientQuotas:
                 raise QuotaExceeded(
                     f"client {client!r} quota exhausted "
                     f"({b[0]:.2f}/{self._policy.burst:g} tokens, cost "
-                    f"{cost:g}); retry in ~{wait:.2f}s")
+                    f"{cost:g}); retry in ~{wait:.2f}s",
+                    retry_after_s=wait)
             b[0] -= cost
 
     def refund(self, client: Optional[str], cost: float) -> None:

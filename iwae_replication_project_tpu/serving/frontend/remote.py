@@ -21,8 +21,18 @@ already speaks:
   outcome, no reroute);
 * a lost connection fails every outstanding future with
   :class:`~.router.ReplicaUnavailable` and poisons the proxy — subsequent
-  submits raise synchronously, so the parent marks the replica unhealthy
-  and its warm probes drive reconnection attempts.
+  submits raise synchronously, so the parent marks the replica unhealthy.
+
+**Reconnection** is opt-in via ``retry=RetryPolicy(...)``: a poisoned
+proxy re-dials the child tier on the next ``submit`` (the parent's warm
+probes are exactly that — one real request through the replica), rate-
+limited by the policy's decorrelated backoff so a down child is not
+hammered. Without a policy the poison is permanent — the pre-retry
+semantics, pinned by tests/test_frontend.py — and either way the futures
+that were in flight when the connection died stay failed (typed): the
+parent reroutes or the end client retries; the proxy never resends them
+itself. ``close()`` is final: no policy reconnects a proxy its owner shut
+down.
 
 Ops and payload dims are validated locally against the child tier's
 ``info`` document (fetched at connect time), so malformed requests raise
@@ -31,13 +41,17 @@ surfacing as a ``bad_request`` future failure that would smear the replica.
 
 One lock guards the socket write side + the pending-future map; the reader
 thread completes futures strictly outside it (completion callbacks — the
-parent router's — re-enter :meth:`submit`).
+parent router's — re-enter :meth:`submit`). Reader threads are generation-
+tagged: a thread whose socket belongs to a superseded connection can
+neither poison the proxy nor complete a live future.
 """
 
 from __future__ import annotations
 
+import contextlib
 import socket
 import threading
+import time
 from concurrent.futures import Future
 from typing import Any, Dict, Optional
 
@@ -46,7 +60,12 @@ from iwae_replication_project_tpu.serving.batcher import (
     RequestTimeout,
     complete_future as _complete,
 )
+from iwae_replication_project_tpu.serving.faults import (
+    SITE_REMOTE_SEND,
+    fault_point,
+)
 from iwae_replication_project_tpu.serving.frontend import protocol
+from iwae_replication_project_tpu.serving.frontend.retry import RetryPolicy
 from iwae_replication_project_tpu.serving.frontend.router import (
     ReplicaUnavailable,
 )
@@ -66,30 +85,62 @@ class RemoteEngine:
     """The engine surface over one TCP connection to a serving tier."""
 
     def __init__(self, host: str, port: int, *,
-                 connect_timeout_s: float = 30.0):
+                 connect_timeout_s: float = 30.0,
+                 retry: Optional[RetryPolicy] = None):
         self._addr = (host, port)
-        self._sock = socket.create_connection((host, port),
-                                              timeout=connect_timeout_s)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._reader = protocol.LineReader(self._sock)
+        self._connect_timeout_s = connect_timeout_s
+        self._retry = retry
         self._lock = threading.Lock()
         self._idle = threading.Condition(self._lock)
         #: wire id -> Future for every in-flight request (guarded by _lock)
         self._pending: Dict[int, Future] = {}
         self._next_id = 0
-        self._dead: Optional[str] = None    # poison reason once connection dies
-        # the child tier's shape contract, fetched synchronously before the
-        # reader thread takes over the receive side
-        self._sock.sendall(protocol.encode_line({"id": 0, "op": "info"}))
-        line = self._reader.next_line()
-        if line is None:
-            raise ConnectionError(f"tier at {host}:{port} closed during "
-                                  "the info handshake")
-        info = protocol.decode_line(line)
-        if not info.get("ok"):
-            raise ConnectionError(
-                f"tier info handshake failed: {info.get('message')}")
-        doc = info["result"]
+        self._dead: Optional[str] = None  # poison reason once connection dies
+        self._closed = False              # close() is final even under retry
+        self._dialing = False             # one reconnect dial at a time
+        self._gen = 0                     # connection generation (reader tag)
+        self._backoff = None              # reconnect delay stream (lazy)
+        self._next_reconnect_t = 0.0
+        #: successful re-dials (the parent's probe-driven recovery evidence)
+        self.reconnects = 0
+        self._install_locked(*self._dial())  # ctor is single-threaded
+
+    # -- connection management ----------------------------------------------
+
+    def _dial(self):
+        """Dial + info handshake; returns ``(sock, reader, doc)``. Mutates
+        NO proxy state, so the reconnect path can run it OUTSIDE the lock —
+        a black-holed dial (connect_timeout_s) must never stall other lock
+        users (close(), stop(), concurrent submits, the reader thread)."""
+        host, port = self._addr
+        sock = socket.create_connection(self._addr,
+                                        timeout=self._connect_timeout_s)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            reader = protocol.LineReader(sock)
+            # the child tier's shape contract, fetched synchronously before
+            # the reader thread takes over the receive side
+            sock.sendall(protocol.encode_line({"id": 0, "op": "info"}))
+            line = reader.next_line()
+            if line is None:
+                raise ConnectionError(f"tier at {host}:{port} closed "
+                                      "during the info handshake")
+            info = protocol.decode_line(line)
+            if not info.get("ok"):
+                raise ConnectionError(
+                    f"tier info handshake failed: {info.get('message')}")
+        except BaseException:
+            # EVERY handshake failure (timeout, garbage, refusal) must
+            # close the fd it dialed: a flapping child under reconnect
+            # backoff would otherwise leak one socket per attempt
+            sock.close()
+            raise
+        sock.settimeout(None)       # the reader blocks; handshake timed
+        return sock, reader, info["result"]
+
+    def _install_locked(self, sock, reader, doc) -> None:
+        """Publish a dialed connection (caller holds ``_lock``, or is the
+        single-threaded ctor) and spawn its generation-tagged reader."""
         self.row_dims = {op: int(d) for op, d in doc["row_dims"].items()}
         self.k = doc.get("k")
         # capability bits for a PARENT router's large-k classification:
@@ -100,11 +151,65 @@ class RemoteEngine:
         self.sharded = bool(doc.get("sharded_replicas")) and \
             doc.get("sharded_replicas") == doc.get("replicas")
         self.info = doc
-        self._sock.settimeout(None)     # the reader blocks; handshake timed
+        self._sock = sock
+        self._reader = reader
+        self._gen += 1
         self._reader_thread = threading.Thread(
-            target=self._read_loop, name=f"iwae-remote-{host}:{port}",
-            daemon=True)
+            target=self._read_loop, args=(reader, self._gen),
+            name=f"iwae-remote-{self._addr[0]}:{self._addr[1]}", daemon=True)
         self._reader_thread.start()
+
+    def _reconnect_if_needed(self) -> None:
+        """Healthy: no-op. Poisoned: re-dial under the RetryPolicy (one
+        dial at a time, backoff-limited, dial itself OUTSIDE the lock) or
+        raise the typed unavailable."""
+        with self._lock:
+            if self._dead is None:
+                return
+            if self._retry is None or self._closed:
+                raise ReplicaUnavailable(
+                    f"remote tier {self._addr[0]}:{self._addr[1]} is gone "
+                    f"({self._dead})")
+            now = time.monotonic()
+            if now < self._next_reconnect_t:
+                raise ReplicaUnavailable(
+                    f"remote tier {self._addr[0]}:{self._addr[1]} is gone "
+                    f"({self._dead}); next reconnect attempt in "
+                    f"{self._next_reconnect_t - now:.2f}s")
+            if self._dialing:
+                raise ReplicaUnavailable(
+                    f"remote tier {self._addr[0]}:{self._addr[1]} is gone "
+                    f"({self._dead}); a reconnect dial is in progress")
+            self._dialing = True
+            old = self._sock
+        # retire the dead socket first so its reader thread exits instead
+        # of hanging on a half-open connection
+        with contextlib.suppress(OSError):
+            old.close()
+        try:
+            sock, reader, doc = self._dial()
+        except (OSError, protocol.ProtocolError) as e:
+            with self._lock:
+                self._dialing = False
+                if self._backoff is None:
+                    self._backoff = self._retry.backoff(stream=self._gen)
+                self._next_reconnect_t = \
+                    time.monotonic() + self._backoff.next_delay()
+            raise ReplicaUnavailable(
+                f"remote tier reconnect failed: {e}") from None
+        with self._lock:
+            self._dialing = False
+            if self._closed:
+                # close() won the race against the dial: stay final
+                sock.close()
+                raise ReplicaUnavailable(
+                    f"remote tier {self._addr[0]}:{self._addr[1]} is gone "
+                    f"(closed)")
+            self._install_locked(sock, reader, doc)
+            self._dead = None
+            self._backoff = None
+            self._next_reconnect_t = 0.0
+            self.reconnects += 1
 
     # -- engine surface ------------------------------------------------------
 
@@ -114,7 +219,9 @@ class RemoteEngine:
 
         Validation (unknown op, wrong feature count, poisoned connection)
         raises synchronously, exactly like the in-process engine — the
-        parent router's submit-failure path handles it.
+        parent router's submit-failure path handles it. Under a
+        ``RetryPolicy`` a poisoned proxy first attempts one (backoff-
+        limited) reconnect, so the parent's warm probes drive recovery.
         """
         if op not in self.row_dims:
             raise ValueError(
@@ -133,9 +240,14 @@ class RemoteEngine:
                 # every boundary a seed can enter the fleet through
                 raise ValueError(f"seed must be in [0, 2**31), got {seed}")
             req["seed"] = seed
+        # poisoned: under a RetryPolicy, attempt ONE backoff-limited re-dial
+        # (the parent's warm probe lands here); otherwise — or after
+        # close() — the poison is final. The dial runs outside the lock.
+        self._reconnect_if_needed()
         fut: Future = Future()
         with self._lock:
             if self._dead is not None:
+                # died again between the reconnect check and the send
                 raise ReplicaUnavailable(
                     f"remote tier {self._addr[0]}:{self._addr[1]} is gone "
                     f"({self._dead})")
@@ -143,6 +255,9 @@ class RemoteEngine:
             req["id"] = self._next_id
             self._pending[self._next_id] = fut
             try:
+                # chaos hook: an injected OSError severs the proxy exactly
+                # like a mid-send connection loss
+                fault_point(SITE_REMOTE_SEND, addr=self._addr)
                 self._sock.sendall(protocol.encode_line(req))
             except OSError as e:
                 del self._pending[self._next_id]
@@ -170,22 +285,24 @@ class RemoteEngine:
 
     # -- receive side --------------------------------------------------------
 
-    def _read_loop(self) -> None:
+    def _read_loop(self, reader: protocol.LineReader, gen: int) -> None:
         while True:
             try:
-                line = self._reader.next_line()
+                line = reader.next_line()
             except (protocol.ProtocolError, OSError) as e:
-                self._fail_all(f"receive failed: {e}")
+                self._conn_lost(gen, f"receive failed: {e}")
                 return
             if line is None:
-                self._fail_all("tier closed the connection")
+                self._conn_lost(gen, "tier closed the connection")
                 return
             try:
                 resp = protocol.decode_line(line)
             except protocol.ProtocolError as e:
-                self._fail_all(f"malformed response: {e}")
+                self._conn_lost(gen, f"malformed response: {e}")
                 return
             with self._lock:
+                if gen != self._gen:
+                    return      # superseded connection: not ours to serve
                 fut = self._pending.pop(resp.get("id"), None)
                 self._idle.notify_all()
             if fut is None:
@@ -203,8 +320,13 @@ class RemoteEngine:
                                          RuntimeError)
                 _complete(fut, exc=exc_type(resp.get("message", "")))
 
-    def _fail_all(self, reason: str) -> None:
+    def _conn_lost(self, gen: int, reason: str) -> None:
+        """A reader thread's connection died: poison the proxy and fail
+        everything outstanding — UNLESS the proxy already moved on to a
+        newer connection (then the stale thread just exits)."""
         with self._lock:
+            if gen != self._gen:
+                return
             if self._dead is None:
                 self._dead = reason
             orphans = list(self._pending.values())
@@ -216,13 +338,15 @@ class RemoteEngine:
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             if self._dead is None:
                 self._dead = "closed"
+            sock = self._sock
         try:
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:  # iwaelint: disable=swallowed-exception -- best-effort shutdown: the socket may already be dead, and close() below is the real teardown
             pass
-        self._sock.close()
+        sock.close()
 
     def __enter__(self) -> "RemoteEngine":
         return self

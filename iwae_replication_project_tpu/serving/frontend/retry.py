@@ -1,0 +1,114 @@
+"""RetryPolicy: the client half of the failure model.
+
+Every typed rejection in this tier ends with "retry with backoff" — this
+module is where somebody finally does. One frozen policy object drives
+both self-healing surfaces:
+
+* :class:`~.client.TierClient` (``retry=`` ctor arg) — blocking requests
+  retry typed-retryable errors and reconnect across dropped/garbled
+  connections, with optional tail-latency hedging;
+* :class:`~.remote.RemoteEngine` (``retry=`` ctor arg) — a poisoned proxy
+  re-dials the child tier on the next submit (rate-limited by the same
+  backoff), so a parent router's warm probes drive reconnection instead
+  of writing the replica off forever.
+
+Semantics:
+
+* **backoff** is exponential with *decorrelated jitter*:
+  ``sleep = min(cap, uniform(base, prev_sleep * 3))`` — retries from many
+  clients de-synchronize instead of stampeding in lockstep. The jitter
+  stream is ``random.Random`` seeded from ``(policy.seed, attempt
+  context)``: a chaos run replays bitwise;
+* **per-code retryability**: ``retry_codes`` names which typed protocol
+  codes are worth retrying. Default: everything except ``bad_request`` —
+  the request itself is wrong; and note retrying a served-but-lost
+  request is SAFE here because serving results are a pure function of
+  (weights, payload, seed, k), so a caller that pins its seed gets
+  bitwise the same answer on any attempt;
+* **retry_after_s**: ``overloaded`` / ``quota_exceeded`` responses carry a
+  machine-readable wait hint (protocol.py); the policy sleeps
+  ``max(backoff, hint)`` — an exact quota refill beats guessing. A
+  ``quota_exceeded`` WITHOUT a hint is the cost-above-burst rejection
+  that no wait can ever admit — the client raises it immediately (split
+  the request) instead of burning its attempt budget;
+* **deadline**: one overall budget per logical request across all
+  attempts and hedges; when the next sleep would cross it, the last
+  error surfaces;
+* **hedging** (``hedge_after_s``): a blocking request unanswered after
+  the hedge delay is re-sent on a SECOND connection with the same seed;
+  first response wins and the loser's connection is closed (first-wins
+  cancellation — the abandoned tier work completes harmlessly and its
+  write is dropped). With an explicit seed the two are bitwise
+  identical, so hedging is invisible except in latency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import FrozenSet, Optional
+
+__all__ = ["RetryPolicy", "Backoff", "DEFAULT_RETRY_CODES"]
+
+#: codes worth retrying (see module docstring); ``bad_request`` never is
+DEFAULT_RETRY_CODES: FrozenSet[str] = frozenset(
+    {"overloaded", "quota_exceeded", "timeout", "unavailable", "internal"})
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Client retry/hedging knobs (frozen: share one across threads)."""
+
+    max_attempts: int = 6
+    base_delay_s: float = 0.02
+    max_delay_s: float = 2.0
+    #: overall wall budget per logical request (None = unbounded)
+    deadline_s: Optional[float] = 30.0
+    retry_codes: FrozenSet[str] = DEFAULT_RETRY_CODES
+    #: also retry dropped/garbled connections (reconnecting first)
+    retry_connection_errors: bool = True
+    #: blocking-path tail-latency hedge delay (None = no hedging)
+    hedge_after_s: Optional[float] = None
+    #: seeds the jitter streams — chaos runs replay bitwise
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}")
+        if self.base_delay_s < 0 or self.max_delay_s < self.base_delay_s:
+            raise ValueError(
+                f"need 0 <= base_delay_s <= max_delay_s, got "
+                f"{self.base_delay_s} / {self.max_delay_s}")
+        unknown = set(self.retry_codes) - set(DEFAULT_RETRY_CODES) - \
+            {"bad_request"}
+        if unknown:
+            raise ValueError(f"unknown retry code(s): {sorted(unknown)}")
+
+    def retryable(self, code: str) -> bool:
+        return code in self.retry_codes
+
+    def backoff(self, stream: int = 0) -> "Backoff":
+        """A fresh deterministic delay stream (one per logical request;
+        `stream` decorrelates concurrent requests under one policy —
+        integer mixing, not the deprecated tuple seeding)."""
+        return Backoff(self, random.Random(self.seed * 1_000_003 + stream))
+
+
+class Backoff:
+    """Stateful decorrelated-jitter delay generator for ONE logical
+    request: ``next_delay(hint)`` returns how long to sleep before the
+    next attempt, honoring a server ``retry_after_s`` hint as a floor."""
+
+    def __init__(self, policy: RetryPolicy, rng: random.Random):
+        self._policy = policy
+        self._rng = rng
+        self._prev = policy.base_delay_s
+
+    def next_delay(self, retry_after_s: Optional[float] = None) -> float:
+        p = self._policy
+        self._prev = min(p.max_delay_s,
+                         self._rng.uniform(p.base_delay_s,
+                                           max(p.base_delay_s,
+                                               self._prev * 3.0)))
+        return max(self._prev, retry_after_s or 0.0)

@@ -76,6 +76,10 @@ from iwae_replication_project_tpu.serving.batcher import (
     complete_future,
 )
 from iwae_replication_project_tpu.serving.buckets import validate_k
+from iwae_replication_project_tpu.serving.faults import (
+    SITE_ROUTER_DISPATCH,
+    fault_point,
+)
 from iwae_replication_project_tpu.telemetry.registry import MetricRegistry
 
 __all__ = ["ReplicaRouter", "TierOverloaded", "ReplicaUnavailable"]
@@ -257,6 +261,14 @@ class ReplicaRouter:
         a Future is returned, it ALWAYS completes — with a result, or with
         one of the typed errors above, or :class:`~..batcher.RequestTimeout`.
         """
+        if not any(r.serves(op) for r in self._replicas):
+            # typed bad_request, not 'unavailable': NO replica serves this
+            # op even when fully healthy — the request is wrong, and a
+            # retrying client must not burn its budget on it
+            served = sorted(set().union(*(r.ops for r in self._replicas
+                                          if r.ops is not None)))
+            raise ValueError(f"unknown op {op!r}; this fleet serves "
+                             f"{served}")
         if k is not None:
             # typed bad_request at the tier boundary: an out-of-range k is
             # rejected HERE, before it can occupy the ceiling or reach a
@@ -351,6 +363,10 @@ class ReplicaRouter:
                 t.t_dispatch = self._clock()
                 self._publish_replica(r)
             try:
+                # chaos hook inside the try: an injected raise is attributed
+                # to THIS replica (submit-time failure path), like a real one
+                fault_point(SITE_ROUTER_DISPATCH, router=self,
+                            replica=r.index, attempt=t.attempts)
                 # outside the lock: engine.submit takes the engine's own
                 # lock and may block briefly; the router lock never nests
                 # around foreign blocking work

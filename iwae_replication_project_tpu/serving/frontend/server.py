@@ -35,6 +35,10 @@ import threading
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 from iwae_replication_project_tpu.serving.buckets import validate_k
+from iwae_replication_project_tpu.serving.faults import (
+    SITE_TIER_WRITE,
+    fault_point,
+)
 from iwae_replication_project_tpu.serving.frontend import protocol
 from iwae_replication_project_tpu.serving.frontend.quotas import (
     ClientQuotas,
@@ -80,17 +84,29 @@ class _Connection:
         with self._lock:
             if self._dead:
                 return
+            # chaos hook, under the write lock so injected garbage/drops
+            # are frame-aligned (deterministic runs); actions here touch
+            # the socket only and never raise — the sendall below then
+            # fails exactly like a real peer reset
+            fault_point(SITE_TIER_WRITE, sock=self._sock, conn=self)
             try:
                 self._sock.sendall(data)
-            except OSError:
+            except OSError:  # iwaelint: disable=swallowed-exception -- deliberate: the client vanished and the response has no destination; _dead is the handled signal and the read loop retires the connection
                 # the client vanished; the response was produced — nothing
                 # to deliver it to. Reads will fail and retire the loop.
                 self._dead = True
 
     def _respond_error(self, req_id: Any, exc: BaseException) -> None:
+        code = protocol.error_code_for(exc)
+        # machine-readable back-off: the exception's own computed wait
+        # (QuotaExceeded carries the exact refill time) beats the tier's
+        # configured shed hint, which beats nothing
+        hint = getattr(exc, "retry_after_s", None)
+        if hint is None and code == "overloaded":
+            hint = self._tier.shed_retry_after_s
         self._write(protocol.error_response(
-            req_id, protocol.error_code_for(exc),
-            f"{type(exc).__name__}: {exc}"))
+            req_id, code, f"{type(exc).__name__}: {exc}",
+            retry_after_s=hint))
 
     # -- request handling (read-loop thread + future callbacks) -------------
 
@@ -218,7 +234,7 @@ class _Connection:
             self._dead = True
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
+        except OSError:  # iwaelint: disable=swallowed-exception -- best-effort shutdown of a possibly already-dead peer socket; close() below is the real teardown
             pass
         self._sock.close()
 
@@ -261,6 +277,7 @@ class ServingTier:
                  probe_timeout_s: float = 5.0,
                  monitor_interval_s: float = 0.25,
                  large_k_threshold: Optional[int] = None,
+                 shed_retry_after_s: float = 0.05,
                  registry=None):
         self.router = ReplicaRouter(
             engines, max_outstanding=max_outstanding,
@@ -271,6 +288,10 @@ class ServingTier:
         self.registry = self.router.registry
         self.quotas = ClientQuotas(quota)
         self._quota = quota
+        #: the ``retry_after_s`` hint stamped on ``overloaded`` responses
+        #: that carry no exact wait of their own (queue-shed recovery time
+        #: is unknowable server-side; this is the tier's suggested pause)
+        self.shed_retry_after_s = float(shed_retry_after_s)
         self._host = host
         self._requested_port = port
         self._monitor_interval_s = monitor_interval_s

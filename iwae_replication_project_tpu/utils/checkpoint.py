@@ -5,18 +5,44 @@ experiment_example.py:95; here a checkpoint is the full resumable state).
 A checkpoint = model params + optimizer state + RNG key + step counter +
 stage index (+ the experiment config JSON), written atomically by Orbax with
 retention of the newest `keep` steps.
+
+**Integrity**: every save also writes a manifest
+(``<directory>/manifests/<step>.json``: per-file size + SHA-256 over the
+step's tree — outside the step directory, so Orbax's own layout stays
+untouched). :func:`restore_latest` verifies the newest step against its
+manifest BEFORE handing it to Orbax and, on a mismatch (the classic
+truncated-by-preemption write), falls back to the newest intact retained
+step with a loud warning instead of crashing the run — Orbax keeps
+``keep=3`` steps precisely so there is somewhere to fall back to. A step
+with no manifest (pre-integrity checkpoints) is accepted as before; if
+Orbax then fails to read it, the fallback walk continues. Training replay
+is deterministic (the whole-epoch scan carries the RNG key), so resuming
+from an older intact step reproduces bitwise the run the newest step would
+have — it just redoes a few passes (pinned by the chaos smoke).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Optional, Tuple
+import sys
+from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
 from iwae_replication_project_tpu.training.train_step import TrainState
+from iwae_replication_project_tpu.utils.faults import (
+    SITE_CKPT_SAVE,
+    fault_point,
+)
+
+
+class CheckpointConfigMismatch(ValueError):
+    """The stored config belongs to a DIFFERENT experiment — a run-dir
+    collision, never an integrity problem: no fallback, refuse loudly."""
 
 
 def _config_identity(config_json: str) -> Optional[dict]:
@@ -28,7 +54,6 @@ def _config_identity(config_json: str) -> Optional[dict]:
     fields they share. Returns None (treated as no-information, not mismatch)
     for unparseable payloads."""
     import dataclasses
-    import json
 
     from iwae_replication_project_tpu.utils.config import (
         SCIENCE_FIELDS,
@@ -53,6 +78,148 @@ def _manager(directory: str, keep: int = 3) -> ocp.CheckpointManager:
     )
 
 
+# ---------------------------------------------------------------------------
+# integrity manifests
+# ---------------------------------------------------------------------------
+
+def _manifest_path(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), "manifests",
+                        f"{int(step)}.json")
+
+
+def _step_dir(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), str(int(step)))
+
+
+def _file_digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _walk_step_files(step_dir: str) -> List[str]:
+    out = []
+    for dirpath, _, filenames in os.walk(step_dir):
+        for fname in filenames:
+            out.append(os.path.relpath(os.path.join(dirpath, fname),
+                                       step_dir))
+    return sorted(out)
+
+
+def write_manifest(directory: str, step: int) -> str:
+    """Record (size, sha256) of every file under the step's tree. Written
+    atomically (tmp + rename) OUTSIDE the step directory so Orbax's layout
+    and retention are untouched; returns the manifest path."""
+    step_dir = _step_dir(directory, step)
+    files = {rel: {"bytes": os.path.getsize(os.path.join(step_dir, rel)),
+                   "sha256": _file_digest(os.path.join(step_dir, rel))}
+             for rel in _walk_step_files(step_dir)}
+    path = _manifest_path(directory, step)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"step": int(step), "files": files}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def prune_manifests(directory: str, live_steps: List[int]) -> None:
+    """Drop manifests for steps Orbax's retention already deleted."""
+    mdir = os.path.join(os.path.abspath(directory), "manifests")
+    if not os.path.isdir(mdir):
+        return
+    live = {f"{int(s)}.json" for s in live_steps}
+    for fname in os.listdir(mdir):
+        if fname.endswith(".json") and fname not in live:
+            os.remove(os.path.join(mdir, fname))
+
+
+def verify_checkpoint(directory: str, step: int,
+                      subtree: Optional[str] = None) -> Optional[str]:
+    """Check the step's files against its manifest. Returns None when
+    intact — or when no manifest exists (pre-integrity checkpoints carry
+    no information; the restore path treats them as before) — else a
+    human-readable description of the FIRST mismatch (missing/truncated/
+    corrupted file, or a whole missing step directory). ``subtree``
+    restricts verification to files under that item (e.g. ``"meta"`` for
+    consumers that only read the config JSON — hashing a multi-GB state
+    tree to read a 1 KB meta blob would be pure startup latency)."""
+    mpath = _manifest_path(directory, step)
+    if not os.path.isfile(mpath):
+        return None
+    try:
+        with open(mpath, "r", encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        return f"unreadable manifest {mpath}: {e}"
+    step_dir = _step_dir(directory, step)
+    if not os.path.isdir(step_dir):
+        return f"step directory missing: {step_dir}"
+    for rel, want in sorted(manifest.get("files", {}).items()):
+        if subtree is not None and \
+                not rel.startswith(subtree.rstrip("/") + "/"):
+            continue
+        path = os.path.join(step_dir, rel)
+        if not os.path.isfile(path):
+            return f"missing file: {rel}"
+        size = os.path.getsize(path)
+        if size != want["bytes"]:
+            return (f"size mismatch on {rel}: {size} bytes on disk vs "
+                    f"{want['bytes']} in the manifest (truncated write?)")
+        if _file_digest(path) != want["sha256"]:
+            return f"checksum mismatch on {rel} (corrupted contents)"
+    return None
+
+
+def checkpoint_steps(directory: str) -> List[int]:
+    """Retained step numbers, newest first (empty when no checkpoints)."""
+    if not os.path.isdir(directory):
+        return []
+    mgr = _manager(directory)
+    steps = sorted((int(s) for s in mgr.all_steps()), reverse=True)
+    mgr.close()
+    return steps
+
+
+def truncate_newest_checkpoint(directory: str) -> Optional[str]:
+    """Chaos helper: truncate the newest step's largest file to half its
+    size — the canonical preemption-mid-write corruption. Returns the
+    mutilated path (None when there is nothing to corrupt). The next
+    :func:`restore_latest` must detect it and fall back."""
+    steps = checkpoint_steps(directory)
+    if not steps:
+        return None
+    step_dir = _step_dir(directory, steps[0])
+    files = [(os.path.getsize(os.path.join(step_dir, rel)), rel)
+             for rel in _walk_step_files(step_dir)]
+    if not files:
+        return None
+    size, rel = max(files)
+    path = os.path.join(step_dir, rel)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, size // 2))
+    return path
+
+
+def _integrity_warn(directory: str, step: int, problem: str) -> None:
+    if jax.process_index() != 0:
+        return
+    msg = (f"WARNING: checkpoint step {step} under {directory!r} failed "
+           f"integrity verification ({problem}); falling back to the "
+           f"newest intact retained checkpoint — the deterministic pass "
+           f"replay reproduces the lost work bitwise")
+    print(msg)
+    print(msg, file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
+# save / restore
+# ---------------------------------------------------------------------------
+
 def save_checkpoint(directory: str, step: int, state: TrainState, stage: int,
                     config_json: str = "", keep: int = 3,
                     passes_done: Optional[int] = None) -> None:
@@ -74,22 +241,47 @@ def save_checkpoint(directory: str, step: int, state: TrainState, stage: int,
         meta=ocp.args.JsonSave(meta),
     ))
     mgr.wait_until_finished()
+    if jax.process_index() == 0:
+        # integrity manifest AFTER the save is durable (wait above), one
+        # writer per multihost job; Orbax already pruned old steps, so the
+        # manifest set mirrors retention exactly
+        write_manifest(directory, step)
+        prune_manifests(directory, [int(s) for s in mgr.all_steps()])
     mgr.close()
+    # chaos hook: fires with the save fully durable — actions here model
+    # corruption that lands AFTER a successful write (the truncated-newest
+    # case restore_latest's fallback exists for)
+    fault_point(SITE_CKPT_SAVE, directory=directory, step=int(step))
 
 
 def stored_config_json(directory: str) -> Optional[str]:
-    """The experiment-config JSON the newest checkpoint was written under
-    (None when no checkpoint, or none stored). Lets consumers that only have
-    a run directory — e.g. the serving engine's ``ServingEngine(ckpt_dir)``
-    path — rebuild the architecture template before restoring weights."""
-    step = latest_step(directory)
-    if step is None:
-        return None
-    mgr = _manager(directory)
-    meta = mgr.restore(step, args=ocp.args.Composite(
-        meta=ocp.args.JsonRestore()))["meta"]
-    mgr.close()
-    return meta.get("config") or None
+    """The experiment-config JSON the newest *intact* checkpoint was written
+    under (None when no checkpoint, or none stored). Lets consumers that only
+    have a run directory — e.g. the serving engine's ``ServingEngine(ckpt_
+    dir)`` path — rebuild the architecture template before restoring weights.
+    Walks the same integrity fallback as :func:`restore_latest`, verifying
+    only the ``meta`` item it actually reads (the config is identical
+    across a run's retained steps — the identity guard enforces that — so
+    hashing the full state tree here would double every consumer's cold
+    start for no information)."""
+    for step in checkpoint_steps(directory):
+        problem = verify_checkpoint(directory, step, subtree="meta")
+        if problem is not None:
+            _integrity_warn(directory, step, problem)
+            continue
+        mgr = _manager(directory)
+        try:
+            meta = mgr.restore(step, args=ocp.args.Composite(
+                meta=ocp.args.JsonRestore()))["meta"]
+        except Exception as e:
+            mgr.close()
+            # no manifest vouched for this step (verify passed vacuously):
+            # treat an unreadable pre-integrity step like a corrupt one
+            _integrity_warn(directory, step, f"unreadable meta: {e}")
+            continue
+        mgr.close()
+        return meta.get("config") or None
+    return None
 
 
 def latest_step(directory: str) -> Optional[int]:
@@ -104,7 +296,7 @@ def latest_step(directory: str) -> Optional[int]:
 def restore_latest(directory: str, template: TrainState, *,
                    expect_config_json: Optional[str] = None
                    ) -> Optional[Tuple[int, TrainState, int, Optional[int]]]:
-    """Restore ``(step, state, stage, passes_done)`` from the newest
+    """Restore ``(step, state, stage, passes_done)`` from the newest intact
     checkpoint, or None. ``passes_done`` is the number of passes completed
     within ``stage`` when the checkpoint was written — None when the stage
     had finished (also for pre-r5 checkpoints, which only saved at stage
@@ -114,15 +306,45 @@ def restore_latest(directory: str, template: TrainState, *,
     fresh TrainState). When `expect_config_json` is given, the stored config is
     compared against it and a mismatch raises instead of silently resuming a
     *different* experiment's weights (run-dir collision protection).
+
+    Integrity: each candidate step is verified against its manifest first;
+    a mismatch (or an unreadable manifest-less step) warns loudly and falls
+    back to the next-newest retained step. A config mismatch
+    (:class:`CheckpointConfigMismatch`) always raises — an intact checkpoint
+    of the WRONG experiment is not something to fall back past.
     """
-    step = latest_step(directory)
-    if step is None:
-        return None
+    for step in checkpoint_steps(directory):
+        problem = verify_checkpoint(directory, step)
+        if problem is not None:
+            _integrity_warn(directory, step, problem)
+            continue
+        vouched = os.path.isfile(_manifest_path(directory, step))
+        try:
+            return _restore_step(directory, step, template,
+                                 expect_config_json)
+        except CheckpointConfigMismatch:
+            raise
+        except Exception as e:
+            if vouched:
+                # the manifest says the files are exactly as written, yet
+                # Orbax cannot read them: that is a code/schema bug, not
+                # corruption — surface it instead of quietly regressing
+                # to older weights
+                raise
+            _integrity_warn(directory, step,
+                            f"unreadable pre-integrity checkpoint: {e}")
+    return None
+
+
+def _restore_step(directory: str, step: int, template: TrainState,
+                  expect_config_json: Optional[str]
+                  ) -> Tuple[int, TrainState, int, Optional[int]]:
     mgr = _manager(directory)
     # meta first: the config-mismatch guard must fire BEFORE the state restore,
     # where a different architecture would die inside Orbax with a cryptic
     # pytree/shape error instead of the intended message
-    meta = mgr.restore(step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))["meta"]
+    meta = mgr.restore(step, args=ocp.args.Composite(
+        meta=ocp.args.JsonRestore()))["meta"]
     stage = int(meta["stage"])
     passes_done = meta.get("passes_done")
     if passes_done is not None:
@@ -130,9 +352,10 @@ def restore_latest(directory: str, template: TrainState, *,
     if expect_config_json:
         stored_id = _config_identity(meta.get("config", ""))
         expect_id = _config_identity(expect_config_json)
-        if stored_id is not None and expect_id is not None and stored_id != expect_id:
+        if stored_id is not None and expect_id is not None \
+                and stored_id != expect_id:
             mgr.close()
-            raise ValueError(
+            raise CheckpointConfigMismatch(
                 f"checkpoint at {directory!r} was written by a different "
                 f"experiment config; refusing to resume.\n"
                 f"stored:  {stored_id}\ncurrent: {expect_id}")
@@ -141,7 +364,6 @@ def restore_latest(directory: str, template: TrainState, *,
         # changes the numerics of the remaining stages — flag the drift so a
         # mixed-precision trajectory is never silent (e.g. a pre-r5 f32
         # checkpoint resumed under the round-5 bfloat16 default)
-        import json
         try:
             stored_dt = json.loads(meta.get("config", "") or "{}")
             cur_dt = json.loads(expect_config_json)

@@ -39,6 +39,11 @@ import threading
 import time
 from typing import Any, Callable, Optional, Tuple
 
+from iwae_replication_project_tpu.utils.faults import (
+    SITE_AOT_CALL_ASYNC,
+    fault_point,
+)
+
 #: default cache location relative to the entry point's persistent directory
 #: (the checkpoint dir for the experiment driver — the one directory already
 #: guaranteed to survive a preemption)
@@ -302,6 +307,10 @@ def aot_call_async(name: str, jitted_fn: Callable, args: Tuple = (),
     kwargs = kwargs or {}
     exe = _registry_get_or_compile(name, jitted_fn, args, kwargs,
                                    static_kwargs, build_key, count_hit=True)
+    # chaos hook (utils/faults.py): every AOT dispatch passes this point,
+    # so an injected raise here models the enqueue-time failure class
+    # (OOM, poisoned runtime) for ANY program; off = one None check
+    fault_point(SITE_AOT_CALL_ASYNC, name=name)
     # every AOT dispatch in the process funnels through here — the ONE span
     # site that covers training epochs, the fused eval suite, and serving
     # alike (the time recorded is enqueue, not device completion: async

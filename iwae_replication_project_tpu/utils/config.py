@@ -177,6 +177,13 @@ class ExperimentConfig:
     # first boundary >= N passes since the last save". Resume restarts
     # mid-stage bit-identically (the whole-epoch scan carries the RNG key).
     checkpoint_every_passes: int = 0
+    # preemption grace (experiment.py + utils/faults.PreemptionGuard): absorb
+    # SIGTERM/SIGINT, finish the in-flight pass, force-save a mid-stage
+    # checkpoint, and exit with the distinct PREEMPTED_EXIT_CODE (75) so the
+    # scheduler re-runs the same command and resume continues bitwise.
+    # --no-preemption-grace restores die-immediately. Execution knob, not a
+    # science field (does not change run_name()).
+    preemption_grace: bool = True
 
     def model_config(self) -> ModelConfig:
         fused = self.fused_likelihood
@@ -318,6 +325,11 @@ def build_argparser() -> argparse.ArgumentParser:
                          "the byte-identical pre-telemetry programs")
     ap.add_argument("--snr-window", dest="snr_window", default=None, type=int,
                     help="trailing train steps in the gradient-SNR estimate")
+    ap.add_argument("--no-preemption-grace", dest="preemption_grace",
+                    action="store_false", default=None,
+                    help="die immediately on SIGTERM/SIGINT instead of "
+                         "finishing the pass, force-saving a mid-stage "
+                         "checkpoint, and exiting 75 (EX_TEMPFAIL)")
     ap.add_argument("--no-resume", dest="resume", action="store_false", default=None)
     ap.add_argument("--no-figures", dest="save_figures", action="store_false",
                     default=None)

@@ -1,0 +1,307 @@
+"""Deterministic fault injection: the chaos layer under the failure model.
+
+Every robustness claim in this stack (router reroute, typed rejections,
+client retry, preemption resume, checkpoint fallback) is only as good as
+the faults it has actually been exercised against. This module is the ONE
+seeded, deterministic way to inject them: a :class:`FaultSchedule` of
+(site, trigger, action) rules installed process-wide, fired from explicit
+:func:`fault_point` hooks threaded through the serving dispatcher, the
+replica router, the tier server, ``RemoteEngine``, ``aot_call_async``, and
+the experiment driver.
+
+Determinism is the design constraint — a chaos run must be a *repro*, not
+a dice roll:
+
+* triggers are **visit counts**, not probabilities: a rule fires on the
+  Nth matched visit of its site (``after`` skips the first N, ``times``
+  bounds total firings), so the same code path under the same traffic
+  produces the same fault sequence every run;
+* the schedule's ``seed`` feeds per-rule ``random.Random`` streams used
+  only where an action wants jitter (:func:`delay`) — same seed, same
+  jitter;
+* every firing is appended to :attr:`FaultSchedule.log` — the audit trail
+  the chaos smoke commits next to its pass/fail verdict.
+
+Off mode is the production mode: :func:`fault_point` with no schedule
+installed is one module-global load and a ``None`` check — it never
+touches the ``ctx`` kwargs beyond building the dict, runs entirely on the
+host, and is invisible to tracing, so compiled programs are byte-identical
+with the hooks present (pinned by tests/test_faults.py).
+
+:class:`PreemptionGuard` lives here too: the resilience half of the
+SIGTERM story (catch the signal, finish the current pass, let the driver
+checkpoint and exit with a distinct code) that the :func:`sigterm` action
+exists to exercise.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import random
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "FaultInjected", "FaultContext", "FaultRule", "FaultSchedule",
+    "fault_point", "install", "clear", "installed", "active",
+    "raise_fault", "raise_error", "delay", "sigterm", "call",
+    "PreemptionGuard",
+    "SITE_AOT_CALL_ASYNC", "SITE_TRAIN_PASS", "SITE_CKPT_SAVE",
+]
+
+#: generic (non-serving) fault sites — the serving-layer site names live in
+#: serving/faults.py next to their rule builders
+SITE_AOT_CALL_ASYNC = "aot.call_async"   # utils/compile_cache.aot_call_async
+SITE_TRAIN_PASS = "train.pass"           # experiment driver, after each pass
+SITE_CKPT_SAVE = "train.checkpoint.save"  # utils/checkpoint.save_checkpoint
+
+
+class FaultInjected(RuntimeError):
+    """An injected fault. Deliberately NOT one of the typed serving errors:
+    the failure model must route it like any unexpected replica exception
+    (``internal`` at the wire), which is exactly what a real crash looks
+    like."""
+
+    def __init__(self, message: str = "injected fault", site: str = ""):
+        super().__init__(message)
+        self.site = site
+
+
+@dataclasses.dataclass
+class FaultContext:
+    """What an action sees when its rule fires."""
+
+    site: str
+    count: int                 # 1-based matched-visit number for the rule
+    ctx: Dict[str, Any]        # the fault_point call's keyword arguments
+    rng: random.Random         # per-rule deterministic stream (seeded)
+
+
+Action = Callable[[FaultContext], None]
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One (site, trigger, action) entry of a schedule.
+
+    ``site`` must match the fault point's name exactly; ``match`` (over the
+    fault point's ctx kwargs) narrows to e.g. one engine instance or one
+    program name. The trigger is count-based: the rule fires on matched
+    visits ``after+1 .. after+times`` (``times=None`` = every matched visit
+    past ``after``). Counters live in the owning schedule, so one rule
+    object may appear in several schedules without cross-talk.
+    """
+
+    site: str
+    action: Action
+    after: int = 0
+    times: Optional[int] = 1
+    match: Optional[Callable[[Dict[str, Any]], bool]] = None
+    name: str = ""             # label for the firing log (default: site)
+
+
+class FaultSchedule:
+    """A seeded, deterministic set of fault rules plus firing state.
+
+    Thread-safe: trigger bookkeeping happens under one lock; actions run
+    OUTSIDE it (they may sleep, raise, or close sockets — holding the lock
+    through that would serialize unrelated fault points). An action that
+    raises propagates out of the instrumented site — that IS the injected
+    crash; any later rule matched at the same visit is skipped, like real
+    code after a raise.
+    """
+
+    def __init__(self, rules: List[FaultRule], seed: int = 0):
+        self.rules = list(rules)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._counts = [0] * len(self.rules)
+        self._fired = [0] * len(self.rules)
+        # per-rule deterministic streams; integer mixing (not tuple
+        # seeding, which Python deprecated) keeps replays stable
+        self._rngs = [random.Random(self.seed * 1_000_003 + i)
+                      for i in range(len(self.rules))]
+        #: firing audit trail: (rule name, site, matched-visit count)
+        self.log: List[Tuple[str, str, int]] = []
+
+    def fire(self, site: str, **ctx) -> None:
+        """Evaluate every rule against one visit of `site`; run the ones
+        that trigger (in rule order, outside the lock). Each firing is
+        committed (counted + logged) immediately BEFORE its action runs:
+        when an earlier action raises — propagating out of the
+        instrumented site, like real code after a crash — the later due
+        rules are neither logged nor have their ``times`` budget spent, so
+        the log never claims a fault that was not actually injected."""
+        due: List[Tuple[int, FaultRule, FaultContext]] = []
+        with self._lock:
+            for i, r in enumerate(self.rules):
+                if r.site != site:
+                    continue
+                if r.match is not None and not r.match(ctx):
+                    continue
+                self._counts[i] += 1
+                if self._counts[i] <= r.after:
+                    continue
+                if r.times is not None and self._fired[i] >= r.times:
+                    continue
+                due.append((i, r,
+                            FaultContext(site=site, count=self._counts[i],
+                                         ctx=ctx, rng=self._rngs[i])))
+        for i, r, fc in due:
+            with self._lock:
+                if r.times is not None and self._fired[i] >= r.times:
+                    continue    # a concurrent visit spent the budget first
+                self._fired[i] += 1
+                self.log.append((r.name or r.site, fc.site, fc.count))
+            r.action(fc)
+
+    def fired(self, name: Optional[str] = None) -> int:
+        """Total firings (of one rule name, or overall) — smoke accounting."""
+        with self._lock:
+            return len(self.log) if name is None else \
+                sum(1 for n, _, _ in self.log if n == name)
+
+
+#: the process-wide installed schedule; None = off (the production state)
+_ACTIVE: Optional[FaultSchedule] = None
+
+
+def active() -> Optional[FaultSchedule]:
+    return _ACTIVE
+
+
+def install(schedule: FaultSchedule) -> FaultSchedule:
+    """Install `schedule` process-wide (replacing any previous one)."""
+    global _ACTIVE
+    _ACTIVE = schedule
+    return schedule
+
+
+def clear() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+@contextlib.contextmanager
+def installed(schedule: FaultSchedule):
+    """``with installed(FaultSchedule([...])) as s:`` — scoped install."""
+    install(schedule)
+    try:
+        yield schedule
+    finally:
+        clear()
+
+
+def fault_point(site: str, **ctx) -> None:
+    """The zero-overhead-when-off hook instrumented code calls.
+
+    Off (no schedule installed): one global load + None check. On: the
+    schedule's matching rules run their actions on the calling thread — a
+    raising action propagates from HERE, i.e. from inside the instrumented
+    site, exactly like an organic failure at that point.
+    """
+    sched = _ACTIVE
+    if sched is not None:
+        sched.fire(site, **ctx)
+
+
+# ---------------------------------------------------------------------------
+# action factories
+# ---------------------------------------------------------------------------
+
+def raise_fault(message: str = "injected fault") -> Action:
+    """Raise :class:`FaultInjected` (reads as an internal crash upstream)."""
+    def act(fc: FaultContext) -> None:
+        raise FaultInjected(f"{message} [site={fc.site} visit={fc.count}]",
+                            site=fc.site)
+    return act
+
+
+def raise_error(make: Callable[[FaultContext], BaseException]) -> Action:
+    """Raise an arbitrary exception built from the firing context — for
+    injecting *typed* failures (e.g. ``OSError`` at a socket send)."""
+    def act(fc: FaultContext) -> None:
+        raise make(fc)
+    return act
+
+
+def delay(seconds: float, jitter_s: float = 0.0) -> Action:
+    """Sleep on the calling thread (plus deterministic seeded jitter) — the
+    slow-replica / slow-network fault."""
+    def act(fc: FaultContext) -> None:
+        time.sleep(seconds + (fc.rng.uniform(0.0, jitter_s)
+                              if jitter_s > 0 else 0.0))
+    return act
+
+
+def sigterm(signum: int = signal.SIGTERM) -> Action:
+    """Deliver a signal to this process (synchronously when fired on the
+    main thread) — the preemption fault :class:`PreemptionGuard` absorbs."""
+    def act(fc: FaultContext) -> None:
+        signal.raise_signal(signum)
+    return act
+
+
+def call(fn: Callable[[FaultContext], None]) -> Action:
+    """Adapter for ad-hoc actions (the schedule stays declarative)."""
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# preemption grace
+# ---------------------------------------------------------------------------
+
+class PreemptionGuard:
+    """Absorb SIGTERM/SIGINT into a checked flag instead of an immediate
+    death: the experiment driver polls :attr:`requested` at pass boundaries,
+    force-saves a mid-stage checkpoint, and exits with its distinct code —
+    so a preempted week-long run loses at most one pass.
+
+    Context manager; handlers are installed on ``__enter__`` and the
+    previous ones restored on ``__exit__``. Signal handlers can only be
+    installed from the main thread — off the main thread the guard is
+    inert (``requested`` stays False) rather than raising, so driver code
+    runs unchanged under test runners that use worker threads. A second
+    signal during the grace window restores the previous handler and
+    re-raises it: the operator's escalation path stays available.
+    """
+
+    def __init__(self, signums: Tuple[int, ...] = (signal.SIGTERM,
+                                                   signal.SIGINT)):
+        self._signums = signums
+        self._old: Dict[int, Any] = {}
+        self._evt = threading.Event()
+        self.signum: Optional[int] = None
+
+    @property
+    def requested(self) -> bool:
+        return self._evt.is_set()
+
+    def _handle(self, signum, frame) -> None:
+        if self._evt.is_set():
+            # escalation: the first signal is grace, the second is now —
+            # hand control back to the previous disposition immediately
+            self._restore()
+            signal.raise_signal(signum)
+            return
+        self.signum = signum
+        self._evt.set()
+
+    def _restore(self) -> None:
+        for s, old in self._old.items():
+            with contextlib.suppress(ValueError, OSError, TypeError):
+                signal.signal(s, old)
+        self._old = {}
+
+    def __enter__(self) -> "PreemptionGuard":
+        if threading.current_thread() is not threading.main_thread():
+            return self          # inert off the main thread (see docstring)
+        for s in self._signums:
+            self._old[s] = signal.signal(s, self._handle)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._restore()

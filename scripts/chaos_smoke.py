@@ -1,0 +1,330 @@
+"""Chaos smoke stage for scripts/check.py: the failure model, exercised.
+
+One short CPU process that runs the stack under a SEEDED fault schedule
+(utils/faults.py + serving/faults.py) and proves the composed resilience
+claims end to end — the standing chaos gate ISSUE 10 asked for:
+
+1. **replica crash mid-burst + transient AOT failure + dropped client
+   connection** — a retrying client (RetryPolicy: backoff + reconnect)
+   drives single-row score requests with EXPLICIT seeds through a
+   two-replica tier while one replica is crashed permanently, one AOT
+   dispatch raises transiently, and one response is dropped on the wire.
+   Every request still completes, and every result is bitwise identical
+   to a fault-free direct-engine run of the same (row, seed) pairs —
+   zero lost futures, zero silence, 100% eventual completion;
+2. **slow replica -> hedge** — one replica's dispatcher stalls; a client
+   with ``hedge_after_s`` re-sends on a second connection, first response
+   wins (bitwise equal, and far sooner than the stall);
+3. **SIGTERM mid-stage + resume** — a sigterm action fires at a chosen
+   training pass; the preemption guard absorbs it, a mid-stage checkpoint
+   is force-saved, run raises TrainingPreempted; the resumed run's final
+   params are bitwise identical to an uninterrupted run;
+4. **truncated-checkpoint fallback** — the newest checkpoint of the
+   preempted run is truncated (the canonical kill-mid-write corruption);
+   resume warns loudly, falls back to the newest intact retained step,
+   and STILL reproduces the uninterrupted run's final params bitwise.
+
+The schedule and retry jitter are seeded, and serving parity rests on
+explicit per-request seeds (results are pure functions of (weights,
+payload, seed, k)) — so a red run is a repro, not a flake. The summary
+(per-stage verdicts + the schedules' firing logs) is committed to
+``results/chaos_smoke.json``.
+
+Exit 0 on success, 1 with a message on the first failed check.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import shutil
+import sys
+import time
+from contextlib import redirect_stderr, redirect_stdout
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SEED = 1234
+
+
+def _tiny_engines():
+    import jax
+
+    from iwae_replication_project_tpu.models import iwae as model
+    from iwae_replication_project_tpu.serving import ServingEngine
+
+    D = 32
+    cfg = model.ModelConfig(x_dim=D, n_hidden_enc=(16, 8),
+                            n_latent_enc=(8, 4), n_hidden_dec=(8, 16),
+                            n_latent_dec=(8, D))
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+
+    def engine():
+        return ServingEngine(params=params, model_config=cfg, k=4,
+                             max_batch=8, max_inflight=2, timeout_s=30.0)
+
+    return engine, D
+
+
+def stage_crash_burst(summary: dict) -> None:
+    """Stage 1: crash + AOT fault + dropped connection vs a retry client."""
+    import numpy as np
+
+    from iwae_replication_project_tpu.serving import faults
+    from iwae_replication_project_tpu.serving.frontend import (
+        RetryPolicy, ServingTier, TierClient)
+
+    engine, D = _tiny_engines()
+    rng = np.random.RandomState(0)
+    n = 24
+    x = (rng.rand(n, D) > 0.5).astype(np.float32)
+
+    # fault-free reference: ONE direct engine, explicit seeds 0..n-1
+    direct = engine()
+    direct.warmup(ops=("score",))
+    futs = [direct.submit("score", x[i], seed=i) for i in range(n)]
+    direct.flush()
+    ref = np.asarray([f.result() for f in futs])
+    direct.stop()
+
+    victim, healthy = engine(), engine()
+    tier = ServingTier([victim, healthy], affinity_slack=0,
+                       monitor_interval_s=0.05)
+    tier.warmup(ops=("score",))
+    tier.start()
+    schedule = faults.FaultSchedule([
+        # replica 0 dies at its 3rd dispatch and STAYS down (probes fail)
+        faults.crash_replica(victim, after=2, times=None),
+        # one transient enqueue-time failure anywhere in the serving fleet
+        faults.crash_aot_dispatch(after=10, times=1),
+        # one response vanishes on the wire mid-delivery
+        faults.drop_tier_connection(after=5, times=1),
+    ], seed=SEED)
+    policy = RetryPolicy(max_attempts=8, base_delay_s=0.02,
+                         deadline_s=30.0, seed=SEED)
+    try:
+        with faults.installed(schedule):
+            with TierClient("127.0.0.1", tier.port, retry=policy) as cli:
+                out = np.asarray([cli.score([x[i].tolist()], seed=i)[0]
+                                  for i in range(n)], dtype=ref.dtype)
+                retry_stats = dict(cli.retry_stats)
+            stats = tier.stats()
+    finally:
+        tier.stop(timeout_s=30)
+
+    assert np.array_equal(out, ref), \
+        "results under chaos differ bitwise from the fault-free run"
+    assert tier.router.outstanding == 0, "drain left requests outstanding"
+    r = stats["router"]
+    assert r["router/replica_failures"] >= 1, r
+    assert r["router/reroutes"] >= 1, r
+    assert retry_stats["reconnects"] >= 1, \
+        f"dropped connection never forced a reconnect: {retry_stats}"
+    assert schedule.fired("crash_replica") >= 1, schedule.log
+    assert schedule.fired("drop_connection") == 1, schedule.log
+    summary["crash_burst"] = {
+        "requests": n, "bitwise_parity": True,
+        "router": {k: r[k] for k in ("router/replica_failures",
+                                     "router/reroutes", "router/routed")},
+        "client_retry_stats": retry_stats,
+        "fault_log": [list(e) for e in schedule.log],
+    }
+    print(f"chaos stage 1 OK: {n}/{n} requests bitwise == fault-free run "
+          f"under crash+aot+drop ({retry_stats})")
+
+
+def stage_slow_replica_hedge(summary: dict) -> None:
+    """Stage 2: a stalled dispatcher; the hedge wins long before it."""
+    import numpy as np
+
+    from iwae_replication_project_tpu.serving import faults
+    from iwae_replication_project_tpu.serving.frontend import (
+        RetryPolicy, ServingTier, TierClient)
+
+    engine, D = _tiny_engines()
+    rng = np.random.RandomState(3)
+    row = (rng.rand(D) > 0.5).astype(np.float32)
+
+    direct = engine()
+    direct.warmup(ops=("score",))
+    f = direct.submit("score", row, seed=0)
+    direct.flush()
+    ref = float(f.result())
+    direct.stop()
+
+    slow, fast = engine(), engine()
+    stall_s = 3.0
+    tier = ServingTier([slow, fast], affinity_slack=0,
+                       monitor_interval_s=0.05)
+    tier.warmup(ops=("score",))
+    tier.start()
+    schedule = faults.FaultSchedule(
+        [faults.slow_replica(slow, delay_s=stall_s, times=1)], seed=SEED)
+    policy = RetryPolicy(max_attempts=4, hedge_after_s=0.15,
+                         deadline_s=30.0, seed=SEED)
+    try:
+        with faults.installed(schedule):
+            with TierClient("127.0.0.1", tier.port, retry=policy) as cli:
+                t0 = time.monotonic()
+                got = cli.score([row.tolist()], seed=0)[0]
+                wall = time.monotonic() - t0
+                retry_stats = dict(cli.retry_stats)
+    finally:
+        tier.stop(timeout_s=30)
+
+    assert float(got) == ref, "hedged result differs from the reference"
+    assert retry_stats["hedges"] >= 1, retry_stats
+    assert retry_stats["hedge_wins"] >= 1, retry_stats
+    assert wall < stall_s - 0.5, \
+        f"hedge did not beat the {stall_s}s stall (took {wall:.2f}s)"
+    summary["slow_replica_hedge"] = {
+        "stall_s": stall_s, "wall_s": round(wall, 3),
+        "bitwise_parity": True, "client_retry_stats": retry_stats,
+        "fault_log": [list(e) for e in schedule.log],
+    }
+    print(f"chaos stage 2 OK: hedge beat a {stall_s}s stall in {wall:.2f}s, "
+          f"bitwise == reference ({retry_stats})")
+
+
+def _tiny_train_cfg(root: str, tag: str):
+    from iwae_replication_project_tpu.utils.config import ExperimentConfig
+
+    return ExperimentConfig(
+        dataset="binarized_mnist", data_dir=os.path.join(root, "data"),
+        n_hidden_encoder=(16,), n_hidden_decoder=(16,),
+        n_latent_encoder=(4,), n_latent_decoder=(784,),
+        loss_function="IWAE", k=4, batch_size=32, n_stages=3,
+        eval_k=4, nll_k=8, nll_chunk=4, eval_batch_size=16,
+        activity_samples=8, save_figures=False,
+        checkpoint_every_passes=2,
+        log_dir=os.path.join(root, f"runs_{tag}"),
+        checkpoint_dir=os.path.join(root, f"ckpt_{tag}"))
+
+
+def _params_equal(a, b) -> bool:
+    import jax
+    import numpy as np
+
+    flat_a = jax.tree.leaves(a)
+    flat_b = jax.tree.leaves(b)
+    return len(flat_a) == len(flat_b) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(flat_a, flat_b))
+
+
+def stage_preempt_resume(summary: dict, scratch: str) -> None:
+    """Stages 3+4: SIGTERM mid-stage, resume parity, then resume parity
+    AGAIN with the newest checkpoint truncated (integrity fallback)."""
+    from iwae_replication_project_tpu.experiment import (
+        TrainingPreempted, run_experiment)
+    from iwae_replication_project_tpu.utils import faults
+    from iwae_replication_project_tpu.utils.checkpoint import (
+        truncate_newest_checkpoint)
+
+    kill_stage, kill_pass = 3, 4
+
+    # uninterrupted reference
+    cfg_a = _tiny_train_cfg(scratch, "ref")
+    state_a, _ = run_experiment(cfg_a, max_batches_per_pass=2,
+                                eval_subset=16)
+
+    # SIGTERM at (stage 3, pass 4): the guard absorbs it at the pass
+    # boundary, force-saves, and raises TrainingPreempted
+    cfg_b = _tiny_train_cfg(scratch, "chaos")
+    schedule = faults.FaultSchedule([faults.FaultRule(
+        site=faults.SITE_TRAIN_PASS, action=faults.sigterm(), times=1,
+        match=lambda ctx: ctx.get("stage") == kill_stage
+        and ctx.get("done") == kill_pass,
+        name="sigterm_mid_stage")], seed=SEED)
+    preempted = False
+    with faults.installed(schedule):
+        try:
+            run_experiment(cfg_b, max_batches_per_pass=2, eval_subset=16)
+        except TrainingPreempted as e:
+            preempted = True
+            assert e.stage == kill_stage and e.passes_done == kill_pass, e
+    assert preempted, "sigterm action did not preempt the run"
+
+    # snapshot the preempted checkpoint tree BEFORE resuming, so the
+    # truncation variant replays from the identical state
+    run_dir = os.path.join(cfg_b.checkpoint_dir, cfg_b.run_name())
+    cfg_c = _tiny_train_cfg(scratch, "chaos_trunc")
+    shutil.copytree(run_dir,
+                    os.path.join(cfg_c.checkpoint_dir, cfg_c.run_name()))
+
+    # stage 3 verdict: plain resume is bitwise identical to uninterrupted
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        state_b, _ = run_experiment(cfg_b, max_batches_per_pass=2,
+                                    eval_subset=16)
+    assert f"stage {kill_stage}, pass {kill_pass + 1}" in buf.getvalue(), \
+        f"resume did not continue mid-stage: {buf.getvalue()[-500:]}"
+    assert _params_equal(state_a.params, state_b.params), \
+        "SIGTERM'd-then-resumed params differ from the uninterrupted run"
+
+    # stage 4 verdict: truncate the newest checkpoint; resume must warn,
+    # fall back to the newest intact step, and STILL match bitwise
+    mutilated = truncate_newest_checkpoint(
+        os.path.join(cfg_c.checkpoint_dir, cfg_c.run_name()))
+    assert mutilated is not None, "nothing to truncate?"
+    buf = io.StringIO()
+    err = io.StringIO()
+    with redirect_stdout(buf), redirect_stderr(err):
+        state_c, _ = run_experiment(cfg_c, max_batches_per_pass=2,
+                                    eval_subset=16)
+    assert "failed integrity verification" in buf.getvalue(), \
+        f"no integrity warning on a truncated checkpoint: " \
+        f"{buf.getvalue()[-500:]}"
+    assert _params_equal(state_a.params, state_c.params), \
+        "truncated-checkpoint fallback broke bitwise resume parity"
+
+    summary["preempt_resume"] = {
+        "kill_at": {"stage": kill_stage, "pass": kill_pass},
+        "resume_bitwise_parity": True,
+        "fault_log": [list(e) for e in schedule.log],
+    }
+    summary["truncated_checkpoint_fallback"] = {
+        "truncated_file": os.path.relpath(mutilated, scratch),
+        "integrity_warning_seen": True,
+        "resume_bitwise_parity": True,
+    }
+    print("chaos stage 3 OK: SIGTERM absorbed, mid-stage save, resumed "
+          "params bitwise == uninterrupted run")
+    print("chaos stage 4 OK: truncated newest checkpoint detected, fell "
+          "back to intact step, resumed params bitwise == uninterrupted run")
+
+
+def main() -> int:
+    import tempfile
+
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        setup_persistent_cache)
+
+    # warm-path discipline, like every entry point: repeated CI runs
+    # deserialize the serving/training programs instead of recompiling
+    setup_persistent_cache(base_dir=REPO)
+
+    summary = {"seed": SEED, "ok": False}
+    stage_crash_burst(summary)
+    stage_slow_replica_hedge(summary)
+    with tempfile.TemporaryDirectory(prefix="iwae_chaos_") as scratch:
+        stage_preempt_resume(summary, scratch)
+    summary["ok"] = True
+
+    out = os.path.join(REPO, "results", "chaos_smoke.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(summary, f, indent=2)
+        f.write("\n")
+    print(f"chaos smoke OK -> {os.path.relpath(out, REPO)}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"chaos smoke FAILED: {e}", file=sys.stderr)
+        sys.exit(1)

@@ -21,7 +21,13 @@ machine-readable summary.
    ``parallel/eval`` scorer and zero recompiles over a ragged (batch, k)
    stream;
 7. **hot-loop smoke** (scripts/hot_loop_smoke.py);
-8. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
+8. **chaos smoke** (scripts/chaos_smoke.py) — the failure model under a
+   seeded fault schedule: replica crash + AOT fault + dropped connection
+   vs a retrying client (bitwise parity, zero lost futures), a slow
+   replica beaten by a client hedge, SIGTERM-mid-stage + resume and
+   truncated-checkpoint fallback both bitwise-identical to an
+   uninterrupted run; summary committed to ``results/chaos_smoke.json``;
+9. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
    ``--sanitize`` armed.
 
 Every full-gate run writes ``results/check_summary.json`` (per-stage status,
@@ -162,6 +168,12 @@ def run_hot_loop_smoke() -> dict:
                                                   "hot_loop_smoke.py")])
 
 
+def run_chaos_smoke() -> dict:
+    return run_step("chaos smoke",
+                    [sys.executable, os.path.join("scripts",
+                                                  "chaos_smoke.py")])
+
+
 def run_tests(extra) -> dict:
     return run_step("tier-1 tests", [
         sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
@@ -204,6 +216,7 @@ def main(argv=None) -> int:
         stages.append(run_serving_tier_smoke())
         stages.append(run_large_k_smoke())
         stages.append(run_hot_loop_smoke())
+        stages.append(run_chaos_smoke())
     if not args.lint_only:
         stages.append(run_tests(passthrough))
 

@@ -406,6 +406,62 @@ BAD_UNLOCKED_STATE = """
 """
 
 
+BAD_SWALLOW = """
+    class Conn:
+        def write(self, data):
+            try:
+                self.sock.sendall(data)
+            except OSError:
+                self.dead = True          # drops the error silently
+
+        def tick(self):
+            try:
+                self.poll()
+            except Exception:
+                pass                      # the classic except-and-drop
+"""
+
+CLEAN_SWALLOW = """
+    class Conn:
+        def write(self, data):
+            try:
+                self.sock.sendall(data)
+            except OSError as e:
+                self.complete(exc=e)      # uses the exception: handled
+
+        def read(self):
+            try:
+                return self.sock.recv(1)
+            except OSError:
+                return None               # explicit decision
+
+        def serve(self):
+            while True:
+                try:
+                    self.step()
+                except OSError:
+                    break                 # explicit decision
+                except ValueError:
+                    continue              # explicit decision
+
+        def probe(self):
+            try:
+                self.step()
+            except Exception:
+                raise                     # re-raised
+"""
+
+SUPPRESSED_SWALLOW = """
+    class Conn:
+        def close(self):
+            try:
+                self.sock.shutdown(2)
+            except OSError:  # iwaelint: disable=swallowed-exception -- best-effort teardown of a possibly dead socket
+                pass
+            self.sock.close()
+"""
+
+
 class TestConcurrencyRules:
     def lint(self, tmp_path, src, rel="conc/m.py"):
         path = tmp_path / rel
@@ -442,17 +498,38 @@ class TestConcurrencyRules:
         assert rules_of(got) == ["unlocked-shared-state"]
         assert "force" in got[0].message
 
+    def test_swallowed_exception_fires_on_drops(self, tmp_path):
+        got = self.lint(tmp_path, BAD_SWALLOW)
+        assert rules_of(got) == ["swallowed-exception"] * 2
+        assert "swallows the error" in got[0].message
+
+    def test_swallowed_exception_clean_shapes(self, tmp_path):
+        # uses-the-exception, return, break, continue, re-raise all count
+        # as handling
+        assert self.lint(tmp_path, CLEAN_SWALLOW) == []
+
+    def test_swallowed_exception_justified_suppression(self, tmp_path):
+        # a deliberate best-effort drop carries its justification in place
+        # (and the suppression is LIVE, so useless-suppression stays quiet)
+        assert self.lint(tmp_path, SUPPRESSED_SWALLOW) == []
+
     def test_outside_concurrency_paths_is_silent(self, tmp_path):
         assert self.lint(tmp_path, BAD_LOCK_ORDER, rel="other/m.py") == []
+        assert self.lint(tmp_path, BAD_SWALLOW, rel="other/m.py") == []
 
     def test_real_concurrency_files_are_clean(self):
-        # the production thread triangle passes its own checker
+        # the production thread fan passes its own checker (the deliberate
+        # best-effort drops carry justified suppressions in place)
         cfg = LintConfig(root=REPO, select=["lock-order",
-                                            "unlocked-shared-state"])
+                                            "unlocked-shared-state",
+                                            "swallowed-exception"])
         files = [os.path.join(REPO, p) for p in (
             "iwae_replication_project_tpu/serving/engine.py",
             "iwae_replication_project_tpu/serving/batcher.py",
-            "iwae_replication_project_tpu/telemetry/registry.py")]
+            "iwae_replication_project_tpu/serving/faults.py",
+            "iwae_replication_project_tpu/serving/frontend",
+            "iwae_replication_project_tpu/telemetry/registry.py",
+            "iwae_replication_project_tpu/utils/faults.py")]
         assert lint_paths(files, cfg, root=REPO) == []
 
 

@@ -1,0 +1,688 @@
+"""Fault-injection layer + resilience tests (ISSUE 10).
+
+Four surfaces:
+
+* the deterministic :class:`FaultSchedule` core (count triggers, matching,
+  seeded streams, audit log) and the off-mode contract — byte-identical
+  lowered programs and near-zero hook cost;
+* :class:`RetryPolicy` + the self-healing ``TierClient`` (typed-error
+  retries honoring ``retry_after_s``, reconnect across dropped/garbled
+  connections, tail-latency hedging) — driven with fake engines at fake
+  speed, faults injected through the REAL tier hook points;
+* ``RemoteEngine`` reconnect semantics against a mid-request server
+  restart: without a policy the poison is permanent (the pre-retry pin);
+  with one, the proxy re-dials a fresh tier on the same port;
+* checkpoint integrity: manifests written per save, verification catching
+  truncation, restore falling back to the newest intact step, pre-manifest
+  checkpoints still restoring.
+
+The full-stack composition (real engines, SIGTERM + resume bitwise
+parity, truncated-checkpoint fallback parity) is the chaos smoke
+(scripts/chaos_smoke.py), a standing scripts/check.py stage.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_frontend import FakeEngine, wait_until
+
+from iwae_replication_project_tpu.serving import faults as sfaults
+from iwae_replication_project_tpu.serving.frontend import (
+    QuotaPolicy,
+    RemoteEngine,
+    ReplicaUnavailable,
+    RetryPolicy,
+    ServingTier,
+    TierClient,
+)
+from iwae_replication_project_tpu.serving.frontend.client import TierError
+from iwae_replication_project_tpu.utils import faults
+from iwae_replication_project_tpu.utils.faults import (
+    FaultRule,
+    FaultSchedule,
+    PreemptionGuard,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_schedule():
+    """Every test leaves the process with fault injection OFF."""
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# FaultSchedule core
+# ---------------------------------------------------------------------------
+
+class TestFaultSchedule:
+    def test_count_trigger_after_times_match(self):
+        hits = []
+        rule = FaultRule(site="s", after=2, times=2, name="r",
+                         match=lambda ctx: ctx.get("tag") == "yes",
+                         action=lambda fc: hits.append(fc.count))
+        sched = FaultSchedule([rule], seed=7)
+        for i in range(10):
+            sched.fire("s", tag="yes")
+            sched.fire("s", tag="no")      # unmatched: not even counted
+            sched.fire("other", tag="yes")  # wrong site
+        # matched visits 3 and 4 fire; visits 1-2 skipped (after), 5+ spent
+        assert hits == [3, 4]
+        assert sched.fired("r") == 2 and sched.fired() == 2
+        assert sched.log == [("r", "s", 3), ("r", "s", 4)]
+
+    def test_action_raise_propagates_from_fault_point(self):
+        sched = faults.install(FaultSchedule(
+            [FaultRule(site="s", action=faults.raise_fault("boom"))]))
+        with pytest.raises(faults.FaultInjected, match="boom"):
+            faults.fault_point("s")
+        # times=1 spent: the next visit is clean
+        faults.fault_point("s")
+        assert sched.fired() == 1
+
+    def test_raising_action_does_not_consume_later_rules(self):
+        """A crash injected by an earlier rule aborts the visit (like real
+        code after a raise): later due rules are neither logged as fired
+        nor have their times budget spent — the audit log never claims a
+        fault that was not actually injected."""
+        hits = []
+        sched = FaultSchedule([
+            FaultRule(site="s", action=faults.raise_fault(), name="a"),
+            FaultRule(site="s", action=lambda fc: hits.append(fc.count),
+                      name="b"),
+        ])
+        with pytest.raises(faults.FaultInjected):
+            sched.fire("s")
+        assert sched.fired("a") == 1 and sched.fired("b") == 0
+        assert hits == []
+        sched.fire("s")        # rule a spent; rule b's budget is intact
+        assert hits == [2] and sched.fired("b") == 1
+
+    def test_seeded_streams_are_deterministic(self):
+        def draws(seed):
+            out = []
+            rule = FaultRule(site="s", times=None,
+                             action=lambda fc: out.append(fc.rng.random()))
+            s = FaultSchedule([rule], seed=seed)
+            for _ in range(5):
+                s.fire("s")
+            return out
+
+        assert draws(3) == draws(3)
+        assert draws(3) != draws(4)
+
+    def test_off_mode_is_cheap(self):
+        """The zero-overhead-when-off pin: 200k no-schedule hook visits in
+        well under a generous bound (one global load + None check each)."""
+        assert faults.active() is None
+        t0 = time.perf_counter()
+        for _ in range(200_000):
+            faults.fault_point("serve.engine.launch")
+        assert time.perf_counter() - t0 < 2.0
+
+    def test_off_mode_programs_byte_identical(self):
+        """Hooks live on the host side of every dispatch: the LOWERED
+        serving program is byte-identical whether or not a schedule is
+        installed — fault injection can never perturb compiled code."""
+        import jax
+
+        from iwae_replication_project_tpu.models import iwae as model
+        from iwae_replication_project_tpu.serving.programs import PROGRAMS
+
+        cfg = model.ModelConfig(x_dim=8, n_hidden_enc=(4,),
+                                n_latent_enc=(2,), n_hidden_dec=(4,),
+                                n_latent_dec=(8,),
+                                fused_likelihood=False)
+        params = model.init_params(jax.random.PRNGKey(0), cfg)
+        program, _ = PROGRAMS["score"]
+
+        def lowered():
+            return program.lower(
+                params, base_key=jax.random.PRNGKey(0),
+                seeds=np.zeros((4,), np.int32),
+                x=np.zeros((4, 8), np.float32), cfg=cfg, k=3).as_text()
+
+        before = lowered()
+        with faults.installed(FaultSchedule([
+                FaultRule(site=sfaults.SITE_ENGINE_LAUNCH, times=None,
+                          action=faults.raise_fault()),
+                FaultRule(site=sfaults.SITE_AOT_CALL_ASYNC, times=None,
+                          action=faults.raise_fault())])):
+            during = lowered()
+        assert before == during
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy / Backoff
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_backoff_deterministic_and_bounded(self):
+        p = RetryPolicy(base_delay_s=0.01, max_delay_s=0.5, seed=11)
+        a = [p.backoff(3).next_delay() for _ in range(1)]
+        seq1 = [d for b in [p.backoff(3)] for d in (b.next_delay(),
+                                                    b.next_delay(),
+                                                    b.next_delay())]
+        b2 = p.backoff(3)
+        seq2 = [b2.next_delay(), b2.next_delay(), b2.next_delay()]
+        assert seq1 == seq2                      # same seed+stream replays
+        assert a[0] == seq1[0]
+        other = p.backoff(4)
+        assert [other.next_delay() for _ in range(3)] != seq1
+        big = p.backoff(0)
+        assert all(0.01 <= big.next_delay() <= 0.5 for _ in range(50))
+
+    def test_hint_is_a_floor(self):
+        p = RetryPolicy(base_delay_s=0.01, max_delay_s=0.02, seed=0)
+        assert p.backoff(0).next_delay(retry_after_s=7.5) == 7.5
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="max_attempts"):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError, match="base_delay"):
+            RetryPolicy(base_delay_s=0.5, max_delay_s=0.1)
+        with pytest.raises(ValueError, match="unknown retry code"):
+            RetryPolicy(retry_codes=frozenset({"not_a_code"}))
+        assert not RetryPolicy().retryable("bad_request")
+        assert RetryPolicy().retryable("overloaded")
+
+
+# ---------------------------------------------------------------------------
+# retry_after_s on the wire
+# ---------------------------------------------------------------------------
+
+class TestRetryAfterHint:
+    def test_quota_exceeded_carries_exact_refill_wait(self):
+        eng = FakeEngine("auto")
+        tier = ServingTier([eng], quota=QuotaPolicy(rate=10.0, burst=2))
+        tier.start()
+        try:
+            with TierClient("127.0.0.1", tier.port, client_id="t") as c:
+                c.score([[0, 0, 0, 0], [0, 0, 0, 0]])       # drain the burst
+                rid = c.submit("score", [[0, 0, 0, 0]])
+                resp = c.drain([rid])[rid]
+                assert resp["error"] == "quota_exceeded"
+                # one token at 10/s refill: ~0.1s, and never negative
+                assert 0.0 <= resp["retry_after_s"] <= 0.11
+        finally:
+            tier.stop(timeout_s=10)
+
+    def test_overloaded_carries_tier_shed_hint(self):
+        tier = ServingTier([FakeEngine("shed")], shed_retry_after_s=0.25)
+        tier.start()
+        try:
+            with TierClient("127.0.0.1", tier.port) as c:
+                rid = c.submit("score", [[0, 0, 0, 0]])
+                resp = c.drain([rid])[rid]
+                assert resp["error"] == "overloaded"
+                assert resp["retry_after_s"] == 0.25
+        finally:
+            tier.stop(timeout_s=10)
+
+    def test_bad_request_carries_no_hint(self):
+        tier = ServingTier([FakeEngine("auto")])
+        tier.start()
+        try:
+            with TierClient("127.0.0.1", tier.port) as c:
+                rid = c.submit("nope", [[0, 0, 0, 0]])
+                resp = c.drain([rid])[rid]
+                assert resp["error"] == "bad_request"
+                assert "retry_after_s" not in resp
+        finally:
+            tier.stop(timeout_s=10)
+
+
+# ---------------------------------------------------------------------------
+# the self-healing TierClient
+# ---------------------------------------------------------------------------
+
+def _policy(**over):
+    kw = dict(max_attempts=6, base_delay_s=0.01, max_delay_s=0.05,
+              deadline_s=10.0, seed=5)
+    kw.update(over)
+    return RetryPolicy(**kw)
+
+
+class TestRetryingClient:
+    def test_retries_until_capacity_returns(self):
+        """Typed overloaded -> backoff -> resend; the quota/overload story
+        finally has a caller that does what the message says."""
+        eng = FakeEngine("shed")
+        tier = ServingTier([eng])
+        tier.start()
+
+        def recover():
+            time.sleep(0.05)
+            eng.mode = "auto"
+
+        threading.Thread(target=recover, daemon=True).start()
+        try:
+            with TierClient("127.0.0.1", tier.port, retry=_policy()) as c:
+                assert c.score([[2, 0, 0, 0]], seed=9) == [9002.0]
+                assert c.retry_stats["retries"] >= 1
+        finally:
+            tier.stop(timeout_s=10)
+
+    def test_quota_retry_honors_hint_and_recovers(self, monkeypatch):
+        from iwae_replication_project_tpu.serving.frontend import client as m
+
+        slept = []
+        real_sleep = time.sleep
+        monkeypatch.setattr(m.time, "sleep",
+                            lambda s: (slept.append(s), real_sleep(s)))
+        eng = FakeEngine("auto")
+        tier = ServingTier([eng], quota=QuotaPolicy(rate=50.0, burst=1))
+        tier.start()
+        try:
+            with TierClient("127.0.0.1", tier.port, client_id="t",
+                            retry=_policy()) as c:
+                assert c.score([[1, 0, 0, 0]], seed=0) == [1.0]  # burst
+                # bucket dry: the retry sleeps >= the exact refill hint
+                assert c.score([[1, 0, 0, 0]], seed=1) == [1001.0]
+                assert slept and max(slept) >= 0.015
+        finally:
+            tier.stop(timeout_s=10)
+
+    def test_bad_request_is_not_retried(self):
+        tier = ServingTier([FakeEngine("auto")])
+        tier.start()
+        try:
+            with TierClient("127.0.0.1", tier.port, retry=_policy()) as c:
+                with pytest.raises(TierError) as ei:
+                    c.request("nope", [[0, 0, 0, 0]])
+                assert ei.value.code == "bad_request"
+                assert c.retry_stats["retries"] == 0
+        finally:
+            tier.stop(timeout_s=10)
+
+    def test_cost_above_burst_quota_rejection_is_terminal(self):
+        """quota_exceeded WITHOUT a refill hint = the cost-above-burst
+        case no wait can admit: raised immediately, zero retries."""
+        tier = ServingTier([FakeEngine("auto")],
+                           quota=QuotaPolicy(rate=100.0, burst=2))
+        tier.start()
+        try:
+            with TierClient("127.0.0.1", tier.port, client_id="t",
+                            retry=_policy()) as c:
+                with pytest.raises(TierError) as ei:
+                    c.score([[0, 0, 0, 0]] * 3)       # 3 rows > burst 2
+                assert ei.value.code == "quota_exceeded"
+                assert ei.value.retry_after_s is None
+                assert c.retry_stats["retries"] == 0
+        finally:
+            tier.stop(timeout_s=10)
+
+    def test_close_is_final_no_silent_redial(self):
+        tier = ServingTier([FakeEngine("auto")])
+        tier.start()
+        try:
+            c = TierClient("127.0.0.1", tier.port, retry=_policy())
+            assert c.score([[1, 0, 0, 0]], seed=0) == [1.0]
+            c.close()
+            with pytest.raises(ConnectionError, match="closed"):
+                c.score([[1, 0, 0, 0]])
+            with pytest.raises(ConnectionError, match="closed"):
+                c.info()
+            assert c.retry_stats["reconnects"] == 0
+        finally:
+            tier.stop(timeout_s=10)
+
+    def test_dropped_connection_reconnects_same_result(self):
+        """A response dropped on the wire (REAL tier hook point): the
+        client reconnects, resends with the SAME seed, and gets the
+        bitwise-identical answer — retries are invisible."""
+        tier = ServingTier([FakeEngine("auto")])
+        tier.start()
+        faults.install(FaultSchedule(
+            [sfaults.drop_tier_connection(after=0, times=1)], seed=1))
+        try:
+            with TierClient("127.0.0.1", tier.port, retry=_policy()) as c:
+                assert c.score([[3, 0, 0, 0]], seed=4) == [4003.0]
+                assert c.retry_stats["reconnects"] == 1
+        finally:
+            faults.clear()
+            tier.stop(timeout_s=10)
+
+    def test_garbled_connection_reconnects_same_result(self):
+        tier = ServingTier([FakeEngine("auto")])
+        tier.start()
+        faults.install(FaultSchedule(
+            [sfaults.garble_tier_connection(after=0, times=1)], seed=1))
+        try:
+            with TierClient("127.0.0.1", tier.port, retry=_policy()) as c:
+                assert c.score([[5, 0, 0, 0]], seed=6) == [6005.0]
+                assert c.retry_stats["reconnects"] >= 1
+        finally:
+            faults.clear()
+            tier.stop(timeout_s=10)
+
+    def test_no_retry_client_sees_connection_error(self):
+        """The pre-retry pin: without a policy, a dropped response is a
+        raised ConnectionError — the caller owns recovery."""
+        tier = ServingTier([FakeEngine("auto")])
+        tier.start()
+        faults.install(FaultSchedule(
+            [sfaults.drop_tier_connection(after=0, times=1)], seed=1))
+        try:
+            with TierClient("127.0.0.1", tier.port) as c:
+                with pytest.raises((ConnectionError, OSError)):
+                    c.score([[0, 0, 0, 0]])
+        finally:
+            faults.clear()
+            tier.stop(timeout_s=10)
+
+    def test_hedge_beats_slow_replica_first_wins(self):
+        """Tail-latency hedging: the primary's replica never answers; the
+        hedge lands on the idle peer and wins with the identical seed."""
+        class SlowFirst(FakeEngine):
+            def __init__(self):
+                super().__init__("manual")
+                self.first = True
+
+            def submit(self, op, row, k=None, *, seed=None):
+                f = super().submit(op, row, k=k, seed=seed)
+                if not self.first:
+                    self.finish()           # later requests answer instantly
+                self.first = False
+                return f
+
+        slow, fast = SlowFirst(), FakeEngine("auto")
+        tier = ServingTier([slow, fast], affinity_slack=0,
+                           monitor_interval_s=0.05)
+        tier.start()
+        try:
+            with TierClient("127.0.0.1", tier.port,
+                            retry=_policy(hedge_after_s=0.1)) as c:
+                t0 = time.monotonic()
+                assert c.score([[4, 0, 0, 0]], seed=8) == [8004.0]
+                assert time.monotonic() - t0 < 5.0
+                assert c.retry_stats["hedges"] == 1
+                assert c.retry_stats["hedge_wins"] == 1
+        finally:
+            slow.finish()
+            tier.stop(timeout_s=10)
+
+
+# ---------------------------------------------------------------------------
+# RemoteEngine reconnect semantics (mid-request server restart)
+# ---------------------------------------------------------------------------
+
+class TestRemoteEngineReconnect:
+    def test_without_policy_poison_is_permanent(self):
+        """The pre-retry pin: the proxy stays dead even after a new tier
+        appears on the same port — recovery is the parent's problem."""
+        eng = FakeEngine("manual")
+        tier = ServingTier([eng], monitor_interval_s=0.05)
+        tier.start()
+        port = tier.port
+        rem = RemoteEngine("127.0.0.1", port)
+        f = rem.submit("score", [0, 0, 0, 0], seed=1)
+        wait_until(lambda: eng.submitted == 1, msg="request routed")
+        tier.stop(timeout_s=5)
+        # mid-request restart: the in-flight future resolves (drain result,
+        # or the typed unavailable), never silence
+        wait_until(f.done, msg="future resolution on restart")
+        assert f.exception() is None or \
+            isinstance(f.exception(), ReplicaUnavailable)
+        wait_until(lambda: rem._dead is not None, msg="proxy poisoning")
+        tier2 = ServingTier([FakeEngine("auto")], port=port,
+                            monitor_interval_s=0.05)
+        tier2.start()
+        try:
+            with pytest.raises(ReplicaUnavailable):
+                rem.submit("score", [0, 0, 0, 0], seed=2)
+        finally:
+            rem.close()
+            tier2.stop(timeout_s=5)
+
+    def test_with_policy_recovers_on_fresh_connection(self):
+        """The retry layer's pin: a poisoned proxy re-dials on the next
+        submit — exactly what a parent router's warm probe performs — and
+        serves from the restarted tier."""
+        eng = FakeEngine("manual")
+        tier = ServingTier([eng], monitor_interval_s=0.05)
+        tier.start()
+        port = tier.port
+        rem = RemoteEngine("127.0.0.1", port, retry=_policy())
+        f = rem.submit("score", [1, 1, 1, 1], seed=3)
+        wait_until(lambda: eng.submitted == 1, msg="request routed")
+        tier.stop(timeout_s=5)
+        wait_until(f.done, msg="in-flight future resolves typed")
+        wait_until(lambda: rem._dead is not None, msg="proxy poisoning")
+        # while the port is vacant, reconnects fail typed (and are
+        # backoff-limited — the parent sees unavailable, not a hang)
+        with pytest.raises(ReplicaUnavailable):
+            rem.submit("score", [0, 0, 0, 0], seed=4)
+        tier2 = ServingTier([FakeEngine("auto")], port=port,
+                            monitor_interval_s=0.05)
+        tier2.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while True:
+                try:
+                    f2 = rem.submit("score", [2, 0, 0, 0], seed=7)
+                    break
+                except ReplicaUnavailable:
+                    assert time.monotonic() < deadline, \
+                        "proxy never reconnected to the restarted tier"
+                    time.sleep(0.02)
+            assert f2.result(timeout=5) == 7002.0
+            assert rem.reconnects == 1
+        finally:
+            rem.close()
+            tier2.stop(timeout_s=5)
+
+    def test_close_is_final_even_with_policy(self):
+        tier = ServingTier([FakeEngine("auto")], monitor_interval_s=0.05)
+        tier.start()
+        try:
+            rem = RemoteEngine("127.0.0.1", tier.port, retry=_policy())
+            rem.close()
+            with pytest.raises(ReplicaUnavailable):
+                rem.submit("score", [0, 0, 0, 0])
+        finally:
+            tier.stop(timeout_s=5)
+
+
+# ---------------------------------------------------------------------------
+# preemption guard
+# ---------------------------------------------------------------------------
+
+class TestPreemptionGuard:
+    def test_absorbs_sigterm_and_restores_handler(self):
+        before = signal.getsignal(signal.SIGTERM)
+        with PreemptionGuard() as g:
+            assert not g.requested
+            signal.raise_signal(signal.SIGTERM)
+            assert g.requested and g.signum == signal.SIGTERM
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_inert_off_main_thread(self):
+        out = {}
+
+        def worker():
+            with PreemptionGuard() as g:
+                out["requested"] = g.requested
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert out == {"requested": False}
+
+    def test_sigterm_on_final_pass_finishes_the_stage(self, tmp_path):
+        """A signal on a stage's FINAL pass boundary lets the stage finish
+        its eval + end-of-stage save before raising — the stage's metrics
+        row must exist (skipping it would lose the row in BOTH the
+        preempted and the resumed run)."""
+        from test_experiment import tiny_config
+
+        from iwae_replication_project_tpu.experiment import (
+            TrainingPreempted, run_experiment)
+
+        cfg = tiny_config(tmp_path, n_stages=2, save_figures=False)
+        sched = FaultSchedule([FaultRule(
+            site=faults.SITE_TRAIN_PASS, action=faults.sigterm(), times=1,
+            match=lambda ctx: ctx.get("stage") == 1
+            and ctx.get("done") == 1)])   # stage 1 trains exactly 1 pass
+        with faults.installed(sched):
+            with pytest.raises(TrainingPreempted) as ei:
+                run_experiment(cfg, max_batches_per_pass=2, eval_subset=16)
+        assert ei.value.stage == 1
+        path = os.path.join(cfg.log_dir, cfg.run_name(), "metrics.jsonl")
+        assert os.path.exists(path), "preempted stage lost its metrics row"
+        state, history = run_experiment(cfg, max_batches_per_pass=2,
+                                        eval_subset=16)
+        assert len(history) == 1 and history[0][0]["stage"] == 2
+
+    def test_driver_grace_saves_and_resumes(self, tmp_path):
+        """Fast end-to-end: a sigterm action at a chosen pass is absorbed,
+        TrainingPreempted carries the save point, and the resumed run
+        continues at the NEXT pass (full bitwise parity incl. checkpoint
+        truncation is the chaos smoke's standing proof)."""
+        from test_experiment import tiny_config
+
+        from iwae_replication_project_tpu.experiment import (
+            TrainingPreempted, run_experiment)
+
+        cfg = tiny_config(tmp_path, n_stages=2, save_figures=False)
+        sched = FaultSchedule([FaultRule(
+            site=faults.SITE_TRAIN_PASS, action=faults.sigterm(), times=1,
+            match=lambda ctx: ctx.get("stage") == 2
+            and ctx.get("done") == 1)])
+        with faults.installed(sched):
+            with pytest.raises(TrainingPreempted) as ei:
+                run_experiment(cfg, max_batches_per_pass=2, eval_subset=16)
+        assert ei.value.stage == 2 and ei.value.passes_done == 1
+        assert signal.getsignal(signal.SIGTERM) is signal.SIG_DFL or \
+            signal.getsignal(signal.SIGTERM) is not None  # restored
+        import io
+        from contextlib import redirect_stdout
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            state, history = run_experiment(cfg, max_batches_per_pass=2,
+                                            eval_subset=16)
+        assert "stage 2, pass 2" in buf.getvalue()
+        assert len(history) == 1 and history[0][0]["stage"] == 2
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def tiny_state():
+    import jax
+
+    from iwae_replication_project_tpu.models.iwae import ModelConfig
+    from iwae_replication_project_tpu.training import (
+        create_train_state, make_adam)
+
+    cfg = ModelConfig(x_dim=8, n_hidden_enc=(4,), n_latent_enc=(2,),
+                      n_hidden_dec=(4,), n_latent_dec=(8,))
+    return create_train_state(jax.random.PRNGKey(0), cfg,
+                              optimizer=make_adam())
+
+
+class TestCheckpointIntegrity:
+    def test_manifest_written_verified_and_pruned(self, tmp_path, tiny_state):
+        from iwae_replication_project_tpu.utils import checkpoint as ck
+
+        d = str(tmp_path / "ckpt")
+        for step in (1, 2, 3, 4):
+            ck.save_checkpoint(d, step, tiny_state, stage=1, keep=3)
+        # retention keeps 3 steps; manifests mirror retention exactly
+        assert ck.checkpoint_steps(d) == [4, 3, 2]
+        mdir = tmp_path / "ckpt" / "manifests"
+        assert sorted(p.name for p in mdir.glob("*.json")) == \
+            ["2.json", "3.json", "4.json"]
+        for step in (2, 3, 4):
+            assert ck.verify_checkpoint(d, step) is None
+
+    def test_truncation_detected_and_fallback_restores(self, tmp_path,
+                                                       tiny_state, capsys):
+        import jax
+
+        from iwae_replication_project_tpu.utils import checkpoint as ck
+
+        d = str(tmp_path / "ckpt")
+        ck.save_checkpoint(d, 1, tiny_state, stage=1, keep=3)
+        ck.save_checkpoint(d, 2, tiny_state, stage=2, keep=3)
+        path = ck.truncate_newest_checkpoint(d)
+        assert path is not None and str(tmp_path) in path
+        problem = ck.verify_checkpoint(d, 2)
+        assert problem is not None and "mismatch" in problem
+        assert ck.verify_checkpoint(d, 1) is None
+        restored = ck.restore_latest(d, tiny_state)
+        assert restored is not None
+        step, state, stage, passes_done = restored
+        assert step == 1 and stage == 1     # fell back to the intact step
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), state.params, tiny_state.params)
+        out = capsys.readouterr()
+        assert "failed integrity verification" in out.out
+        assert "failed integrity verification" in out.err
+
+    def test_all_corrupt_returns_none(self, tmp_path, tiny_state, capsys):
+        from iwae_replication_project_tpu.utils import checkpoint as ck
+
+        d = str(tmp_path / "ckpt")
+        ck.save_checkpoint(d, 1, tiny_state, stage=1, keep=3)
+        ck.truncate_newest_checkpoint(d)
+        assert ck.restore_latest(d, tiny_state) is None
+        assert "falling back" in capsys.readouterr().out
+
+    def test_pre_manifest_checkpoint_still_restores(self, tmp_path,
+                                                    tiny_state):
+        """Checkpoints from before this PR have no manifest: verification
+        is vacuous and restore proceeds exactly as before."""
+        import shutil
+
+        from iwae_replication_project_tpu.utils import checkpoint as ck
+
+        d = str(tmp_path / "ckpt")
+        ck.save_checkpoint(d, 5, tiny_state, stage=2, keep=3)
+        shutil.rmtree(os.path.join(d, "manifests"))
+        assert ck.verify_checkpoint(d, 5) is None
+        restored = ck.restore_latest(d, tiny_state)
+        assert restored is not None and restored[0] == 5
+
+    def test_config_mismatch_still_raises_not_falls_back(self, tmp_path,
+                                                         tiny_state):
+        """An intact checkpoint of the WRONG experiment must refuse, never
+        quietly fall back past it (run-dir collision protection)."""
+        from iwae_replication_project_tpu.utils import checkpoint as ck
+        from iwae_replication_project_tpu.utils.config import (
+            ExperimentConfig)
+
+        d = str(tmp_path / "ckpt")
+        stored = ExperimentConfig(k=7)
+        ck.save_checkpoint(d, 1, tiny_state, stage=1, keep=3,
+                           config_json=stored.to_json())
+        with pytest.raises(ck.CheckpointConfigMismatch):
+            ck.restore_latest(d, tiny_state,
+                              expect_config_json=ExperimentConfig(
+                                  k=13).to_json())
+
+    def test_chaos_truncate_action_composes(self, tmp_path, tiny_state):
+        """The schedule-driven corruption path: a rule at the ckpt-save
+        site truncates the step it just wrote (the kill-mid-write model)."""
+        from iwae_replication_project_tpu.utils import checkpoint as ck
+
+        d = str(tmp_path / "ckpt")
+        sched = FaultSchedule([FaultRule(
+            site=faults.SITE_CKPT_SAVE, after=1, times=1,
+            action=faults.call(
+                lambda fc: ck.truncate_newest_checkpoint(
+                    fc.ctx["directory"])))])
+        with faults.installed(sched):
+            ck.save_checkpoint(d, 1, tiny_state, stage=1, keep=3)
+            ck.save_checkpoint(d, 2, tiny_state, stage=1, keep=3)
+        assert sched.fired() == 1
+        assert ck.verify_checkpoint(d, 2) is not None
+        assert ck.verify_checkpoint(d, 1) is None
+        assert ck.restore_latest(d, tiny_state)[0] == 1
