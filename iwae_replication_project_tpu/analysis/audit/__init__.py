@@ -6,7 +6,10 @@ the AST: donation vs the persistent-cache executables (RESULTS.md §5),
 padded rows reaching the IWAE logsumexp unmasked, host callbacks inside hot
 programs, and cache-fragmenting call signatures. See core.py for the
 framework, passes.py for the four built-in passes, taint.py for the padding
-dataflow engine, and programs.py for the audited production-program suite.
+dataflow engine, programs.py for the audited production-program suite, and
+cost.py for the ``iwae-cost`` static cost analyzer (live-range peak memory,
+FLOP/byte roofline accounting, per-mesh-axis collective profiles) over the
+same traced suite.
 """
 
 from iwae_replication_project_tpu.analysis.audit.core import (

@@ -1,0 +1,683 @@
+"""``iwae-cost``: jaxpr-level memory / FLOP / collective cost analyzer.
+
+The auditor next door (passes.py) proves *safety* facts about the traced
+programs; this module computes their *cost* facts — statically, from the
+same ``jax.make_jaxpr`` traces (no compile, no execution), so the full
+suite analyzes in seconds on any host. Three linked passes per program:
+
+1. **live-range peak memory** — a linear scan over equations computing
+   per-buffer birth/death: frame inputs (and closure consts) are resident
+   for the whole call frame, an intermediate dies at its last use, a
+   donated operand (``donated_invars``) is released *before* the callee's
+   outputs allocate, a ``scan``/``while`` body's working set is counted
+   once (the carry is reused across iterations, not multiplied), and
+   ``pallas_call`` interiors are opaque (their tiles live in scoped VMEM —
+   ``ops/fused_likelihood.fits_vmem`` is that budget's owner; only the
+   kernel's HBM-visible outputs are charged here). Reports peak HBM bytes
+   per program plus a ``memory-blowup`` finding when any single
+   intermediate exceeds a configurable multiple of the program's input
+   bytes — the static form of the OOM class the k=5000 eval exists to
+   avoid (its whole design is O(chunk) memory, arXiv:1509.00519 eval).
+
+2. **FLOP + byte accounting** — per-primitive FLOPs (``dot_general``/conv
+   from dimension numbers, elementwise/reductions by element count as an
+   honest 1-FLOP lower bound) with ``scan`` lengths multiplied through,
+   and HBM traffic bracketed from both sides: ``bytes_accessed`` assumes
+   no fusion (every equation round-trips HBM), ``bytes_accessed_fused``
+   assumes perfect fusion (only program I/O moves). Matmul FLOPs must
+   reconcile **bit-exactly** with ``utils/flops.py``'s analytic tables on
+   the flagship config — pinned by tests/test_cost.py, so the two
+   accountings cross-check each other — and the two traffic bounds give
+   an arithmetic-intensity interval whose position against the chip's
+   ridge point (``peak_flops_for_kind`` / ``peak_hbm_bytes_for_kind``)
+   yields the roofline verdict: compute-bound, memory-bound, or
+   fusion-dependent.
+
+3. **collective accounting** — every ``psum``/``pmax``/``all_gather``/
+   ``ppermute``/... counted and sized per mesh axis. The sharded score
+   program's "ONE pmax + ONE psum" merge contract (PR 9) becomes a
+   machine-checked invariant (test-pinned, and loud in the golden
+   collective histograms), and bandwidth-shaped collectives that
+   materialize a gathered axis on every device (``all_gather``,
+   ``all_to_all``) are findings — an accidental reshard in a per-request
+   program is a serving-latency cliff, not a style problem.
+
+Results flow outward: ``utils/compile_cache`` stamps a ``static_cost``
+record on every AOT registry entry at compile time (the capacity-bounded
+executable store's budget input — ROADMAP item 1), ``bench.py`` stamps the
+static roofline estimate beside every measured MFU, and ``scripts/check.py``
+runs the CLI as a gate stage writing ``results/cost_report.json``.
+
+Exit codes match the lint/audit CLIs: **0** clean, **1** findings,
+**2** internal error — scripts/check.py classifies them the same way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import math
+import sys
+import traceback
+from collections import Counter
+from typing import Any, Dict, List, Optional, Tuple
+
+from iwae_replication_project_tpu.analysis.audit.core import (
+    BARE_WAIVER,
+    AuditFinding,
+    AuditProgram,
+)
+from iwae_replication_project_tpu.analysis.audit.jaxprs import (
+    COLLECTIVE_PRIMS,
+    core_types,
+    open_jaxpr,
+    sub_jaxprs,
+)
+from iwae_replication_project_tpu.utils.dtypes import aval_bytes
+
+#: bandwidth-shaped collectives: these materialize a gathered/resharded
+#: axis on every device — a finding, not just a count (the merge contract
+#: for the sharded score program is pure pmax+psum of [B]-vectors)
+_FLAGGED_COLLECTIVES = frozenset({
+    "all_gather", "all_gather_invariant", "all_to_all", "pgather",
+})
+
+#: control-flow / call primitives the walk recurses through structurally
+_LOOP_PRIMS = ("scan", "while", "cond")
+
+#: the two finding rules this analyzer can emit (waivable per program with
+#: the audit framework's justified-waiver semantics)
+RULE_MEMORY_BLOWUP = "memory-blowup"
+RULE_ACCIDENTAL_GATHER = "accidental-allgather"
+
+#: default memory-blowup threshold: an intermediate this many times the
+#: program's own inputs is a materialized fan-out (the flagship suite's
+#: honest worst case — the eval scorer's [chunk, B, 784] block — sits
+#: near 6x, so 16x only fires on genuine blowups)
+DEFAULT_BLOWUP_FACTOR = 16.0
+
+
+@dataclasses.dataclass
+class CostRecord:
+    """Static cost facts of one traced program (all byte figures HBM)."""
+
+    program: str
+    input_bytes: int = 0
+    output_bytes: int = 0
+    peak_bytes: int = 0
+    largest_intermediate_bytes: int = 0
+    largest_intermediate_site: str = ""
+    flops: float = 0.0
+    matmul_flops: float = 0.0
+    bytes_accessed: float = 0.0        # no-fusion upper bound on traffic
+    bytes_accessed_fused: float = 0.0  # perfect-fusion lower bound (I/O)
+    #: prim -> mesh-axis-tuple (comma-joined) -> {count, bytes}
+    collectives: Dict[str, Dict[str, Dict[str, float]]] = \
+        dataclasses.field(default_factory=dict)
+    collective_bytes: float = 0.0
+    #: while-loops whose trip count is a traced value: their bodies are
+    #: counted ONCE (an honest lower bound, stamped rather than guessed)
+    dynamic_while_loops: int = 0
+    opaque_kernels: int = 0
+
+    @property
+    def intensity(self) -> Optional[float]:
+        """FLOPs per HBM byte assuming no fusion (lower bound)."""
+        return self.flops / self.bytes_accessed if self.bytes_accessed \
+            else None
+
+    @property
+    def intensity_fused(self) -> Optional[float]:
+        """FLOPs per HBM byte at perfect fusion (upper bound)."""
+        return self.flops / self.bytes_accessed_fused \
+            if self.bytes_accessed_fused else None
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["intensity"] = self.intensity
+        d["intensity_fused"] = self.intensity_fused
+        return d
+
+
+# ---------------------------------------------------------------------------
+# roofline
+# ---------------------------------------------------------------------------
+
+def resolve_chip(chip: Optional[str] = None) -> Tuple[str, str]:
+    """``(device_kind, source)`` for the roofline tables: explicit ``--chip``
+    wins; a TPU host contributes its real kind; any other host assumes v5e
+    with the assumption stamped (never silently) — mirroring bench.py's
+    peak-FLOPs detection contract."""
+    if chip:
+        return chip, "explicit --chip"
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform == "tpu":
+        kind = getattr(dev, "device_kind", "tpu")
+        return kind, f"detected device_kind {kind!r}"
+    return "v5e", (f"host platform {dev.platform!r} has no TPU: assuming "
+                   f"v5e — pass --chip to analyze for another generation")
+
+
+def roofline(record: CostRecord, chip: str) -> dict:
+    """The verdict: where the program's intensity interval sits against the
+    chip's ridge point, plus the matmul-MFU ceiling the roofline admits
+    (``matmul_flops / max(total_flops, fused_bytes * ridge)`` — what the
+    bench's measured MFU is bounded by on this chip)."""
+    from iwae_replication_project_tpu.utils.flops import (
+        peak_flops_for_kind,
+        peak_hbm_bytes_for_kind,
+    )
+
+    peak, peak_src = peak_flops_for_kind(chip)
+    bw, bw_src = peak_hbm_bytes_for_kind(chip)
+    out = {"chip": chip, "peak_flops": peak, "hbm_bytes_per_s": bw}
+    if peak is None or bw is None:
+        out["verdict"] = None
+        out["verdict_null_reason"] = peak_src if peak is None else bw_src
+        return out
+    ridge = peak / bw
+    out["ridge_flops_per_byte"] = ridge
+    lo, hi = record.intensity, record.intensity_fused
+    if lo is not None and lo >= ridge:
+        out["verdict"] = "compute-bound"
+    elif hi is not None and hi <= ridge:
+        out["verdict"] = "memory-bound"
+    else:
+        out["verdict"] = "fusion-dependent"
+    denom = max(record.flops, record.bytes_accessed_fused * ridge)
+    out["static_mfu_ceiling"] = record.matmul_flops / denom if denom else None
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the analyzer
+# ---------------------------------------------------------------------------
+
+class CostAnalyzer:
+    """One analyzer instance = one configuration (blow-up threshold);
+    :meth:`analyze` is reusable across programs."""
+
+    def __init__(self, blowup_factor: float = DEFAULT_BLOWUP_FACTOR):
+        self.blowup_factor = float(blowup_factor)
+
+    # -- entry points -------------------------------------------------------
+
+    def analyze(self, prog: AuditProgram
+                ) -> Tuple[CostRecord, List[AuditFinding]]:
+        """Cost record + findings for one audited program, honoring the
+        program's waivers with the audit framework's semantics (a justified
+        waiver silences, a bare one is itself a finding)."""
+        rec, findings = self.analyze_jaxpr(prog.name, prog.jaxpr)
+        kept: List[AuditFinding] = []
+        for rule, justification in prog.waivers.items():
+            if rule in (RULE_MEMORY_BLOWUP, RULE_ACCIDENTAL_GATHER) and \
+                    not (justification or "").strip():
+                kept.append(AuditFinding(
+                    program=prog.name, rule=BARE_WAIVER, location="waivers",
+                    message=f"waiver for '{rule}' has no justification — "
+                            f"every silenced hazard must carry its argument"))
+        waived = {rule for rule, j in prog.waivers.items()
+                  if (j or "").strip()}
+        kept.extend(f for f in findings if f.rule not in waived)
+        return rec, kept
+
+    def analyze_jaxpr(self, name: str, jaxpr: Any
+                      ) -> Tuple[CostRecord, List[AuditFinding]]:
+        """Analyze one ``make_jaxpr`` trace (no waiver filtering)."""
+        rec = CostRecord(program=name)
+        findings: List[AuditFinding] = []
+        self._walk(jaxpr, "", 1.0, rec, findings)
+        rec.peak_bytes = self._frame_peak(jaxpr, "", rec)
+        j = open_jaxpr(jaxpr)
+        rec.input_bytes = sum(aval_bytes(v.aval) for v in
+                              list(j.invars) + list(j.constvars))
+        rec.output_bytes = sum(aval_bytes(v.aval) for v in j.outvars
+                               if hasattr(v, "aval"))
+        rec.bytes_accessed_fused = float(rec.input_bytes + rec.output_bytes)
+        # the no-fusion bound can never be tighter than program I/O
+        rec.bytes_accessed = max(rec.bytes_accessed, rec.bytes_accessed_fused)
+        if rec.input_bytes and rec.largest_intermediate_bytes > \
+                self.blowup_factor * rec.input_bytes:
+            ratio = rec.largest_intermediate_bytes / rec.input_bytes
+            findings.append(AuditFinding(
+                program=name, rule=RULE_MEMORY_BLOWUP,
+                location=rec.largest_intermediate_site,
+                message=f"intermediate of "
+                        f"{rec.largest_intermediate_bytes:,} bytes is "
+                        f"{ratio:.1f}x the program's {rec.input_bytes:,} "
+                        f"input bytes (threshold {self.blowup_factor:g}x) — "
+                        f"a materialized fan-out this size is an OOM cliff "
+                        f"at production k/batch; stream it through a "
+                        f"scan/logsumexp carry or a blocked kernel"))
+        return rec, sorted(set(findings))
+
+    # -- pass 2 + 3: flops / traffic / collectives --------------------------
+
+    def _walk(self, jaxpr: Any, path: str, mult: float,
+              rec: CostRecord, findings: List[AuditFinding],
+              in_kernel: bool = False) -> None:
+        for i, eqn in enumerate(open_jaxpr(jaxpr).eqns):
+            name = eqn.primitive.name
+            loc = f"{path}/{name}[{i}]" if path else f"{name}[{i}]"
+
+            if name in COLLECTIVE_PRIMS:
+                self._collective(eqn, loc, mult, rec, findings)
+
+            if name == "dot_general":
+                f = mult * _dot_general_flops(eqn)
+                rec.flops += f
+                rec.matmul_flops += f
+                if not in_kernel:
+                    rec.bytes_accessed += mult * _eqn_io_bytes(eqn)
+            elif name == "conv_general_dilated":
+                f = mult * _conv_flops(eqn)
+                rec.flops += f
+                rec.matmul_flops += f
+                if not in_kernel:
+                    rec.bytes_accessed += mult * _eqn_io_bytes(eqn)
+            elif name == "scan":
+                length = float(eqn.params.get("length", 1))
+                self._walk(eqn.params["jaxpr"], loc, mult * length,
+                           rec, findings, in_kernel)
+            elif name == "while":
+                # trip count is a traced value: count the body ONCE and
+                # stamp the approximation instead of inventing a trip count
+                rec.dynamic_while_loops += 1
+                self._walk(eqn.params["cond_jaxpr"], loc, mult,
+                           rec, findings, in_kernel)
+                self._walk(eqn.params["body_jaxpr"], loc, mult,
+                           rec, findings, in_kernel)
+            elif name == "cond":
+                # exactly ONE branch executes per dispatch: every cost
+                # field takes the branch-wise MAXIMUM (each independently —
+                # the result is a bound, never a sum over exclusive paths,
+                # which would e.g. double-count a psum present in both
+                # branches of a guarded merge). Findings from EVERY branch
+                # are kept: a hazard on any executable path is real.
+                subs = []
+                for branch in eqn.params["branches"]:
+                    sub = CostRecord(program=rec.program)
+                    self._walk(branch, loc, mult, sub, findings, in_kernel)
+                    subs.append(sub)
+                rec.flops += max(s.flops for s in subs)
+                rec.matmul_flops += max(s.matmul_flops for s in subs)
+                rec.bytes_accessed += max(s.bytes_accessed for s in subs)
+                rec.collective_bytes += max(s.collective_bytes
+                                            for s in subs)
+                rec.dynamic_while_loops += max(s.dynamic_while_loops
+                                               for s in subs)
+                rec.opaque_kernels += max(s.opaque_kernels for s in subs)
+                merged: Dict[Tuple[str, str], Dict[str, float]] = {}
+                for s in subs:
+                    for prim, axes in s.collectives.items():
+                        for ax, c in axes.items():
+                            slot = merged.setdefault(
+                                (prim, ax), {"count": 0.0, "bytes": 0.0})
+                            slot["count"] = max(slot["count"], c["count"])
+                            slot["bytes"] = max(slot["bytes"], c["bytes"])
+                for (prim, ax), c in merged.items():
+                    slot = rec.collectives.setdefault(prim, {}).setdefault(
+                        ax, {"count": 0.0, "bytes": 0.0})
+                    slot["count"] += c["count"]
+                    slot["bytes"] += c["bytes"]
+                for s in subs:
+                    if s.largest_intermediate_bytes > \
+                            rec.largest_intermediate_bytes:
+                        rec.largest_intermediate_bytes = \
+                            s.largest_intermediate_bytes
+                        rec.largest_intermediate_site = \
+                            s.largest_intermediate_site
+            elif name == "pallas_call":
+                # opaque kernel: its interior lives in scoped VMEM, never
+                # HBM (that is the point of the fused hot loop) — charge
+                # only the HBM-visible operands/results, and approximate
+                # its FLOPs by walking the kernel body per grid step
+                rec.opaque_kernels += 1
+                if not in_kernel:
+                    rec.bytes_accessed += mult * _eqn_io_bytes(eqn)
+                for _, sub in sub_jaxprs(eqn):
+                    grid = eqn.params.get("grid_mapping", None)
+                    steps = math.prod(getattr(grid, "grid", ()) or (1,))
+                    self._walk(sub, loc, mult * steps, rec, findings,
+                               in_kernel=True)
+            elif _has_sub_jaxpr(eqn):
+                for _, sub in sub_jaxprs(eqn):
+                    self._walk(sub, loc, mult, rec, findings, in_kernel)
+            else:
+                rec.flops += mult * _pointwise_flops(eqn)
+                if not in_kernel:
+                    rec.bytes_accessed += mult * _eqn_io_bytes(eqn)
+
+            if not in_kernel:
+                # kernel-interior tiles are VMEM-resident (bounded by
+                # ops/fused_likelihood.fits_vmem), not HBM intermediates
+                self._note_intermediates(eqn, loc, rec)
+
+    def _collective(self, eqn, loc: str, mult: float, rec: CostRecord,
+                    findings: List[AuditFinding]) -> None:
+        name = eqn.primitive.name
+        axes = eqn.params.get("axes",
+                              eqn.params.get("axis_name", ()))
+        if not isinstance(axes, (tuple, list)):
+            axes = (axes,)
+        key = ",".join(str(a) for a in axes) or "?"
+        nbytes = mult * sum(aval_bytes(v.aval) for v in eqn.invars
+                            if hasattr(v, "aval"))
+        slot = rec.collectives.setdefault(name, {}).setdefault(
+            key, {"count": 0.0, "bytes": 0.0})
+        slot["count"] += mult
+        slot["bytes"] += nbytes
+        rec.collective_bytes += nbytes
+        if name in _FLAGGED_COLLECTIVES:
+            findings.append(AuditFinding(
+                program=rec.program, rule=RULE_ACCIDENTAL_GATHER,
+                location=loc,
+                message=f"'{name}' over mesh axis ({key}) materializes the "
+                        f"gathered axis on every device "
+                        f"({int(nbytes):,} bytes per dispatch) — the "
+                        f"sharded merge contract is reduction-shaped "
+                        f"(pmax/psum of per-row scalars); an accidental "
+                        f"reshard here is a serving-latency cliff"))
+
+    def _note_intermediates(self, eqn, loc: str, rec: CostRecord) -> None:
+        for v in eqn.outvars:
+            if not hasattr(v, "aval") or type(v).__name__ == "DropVar":
+                continue
+            b = aval_bytes(v.aval)
+            if b > rec.largest_intermediate_bytes:
+                rec.largest_intermediate_bytes = b
+                rec.largest_intermediate_site = loc
+
+    # -- pass 1: live-range peak memory -------------------------------------
+
+    def _frame_peak(self, jaxpr: Any, path: str, rec: CostRecord) -> int:
+        """Peak resident HBM bytes of one call frame: frame inputs (and
+        consts) live for the whole frame, intermediates die at last use,
+        donation releases early, loop bodies count once."""
+        _, _, Var, _ = core_types()
+        j = open_jaxpr(jaxpr)
+        n = len(j.eqns)
+        last: Dict[Any, int] = {}
+        for i, eqn in enumerate(j.eqns):
+            for v in eqn.invars:
+                if isinstance(v, Var):
+                    last[v] = i
+        for v in j.outvars:
+            if isinstance(v, Var):
+                last[v] = n
+        frame_inputs = {v for v in list(j.invars) + list(j.constvars)}
+        current = sum(aval_bytes(v.aval) for v in frame_inputs)
+        peak = current
+        for i, eqn in enumerate(j.eqns):
+            donated = eqn.params.get("donated_invars") or ()
+            freed_early: set = set()
+            for d, v in zip(donated, eqn.invars):
+                # a donated operand's buffer is handed to the callee: it is
+                # reusable for outputs before they allocate — release it
+                # ahead of the allocation if this call is its last use
+                if d and isinstance(v, Var) and last.get(v) == i:
+                    freed_early.add(v)
+            current -= sum(aval_bytes(v.aval) for v in freed_early)
+            out_alloc = sum(
+                aval_bytes(v.aval) for v in eqn.outvars
+                if isinstance(v, Var) and v in last)  # DCE'd outputs free
+            peak = max(peak, current + out_alloc
+                       + self._interior_bytes(eqn, path, rec))
+            current += out_alloc
+            for v in {v for v in eqn.invars if isinstance(v, Var)}:
+                if last.get(v) == i and v not in frame_inputs \
+                        and v not in freed_early:
+                    current -= aval_bytes(v.aval)
+        return peak
+
+    def _interior_bytes(self, eqn, path: str, rec: CostRecord) -> int:
+        """Transient working set a call-like equation holds BEYOND its own
+        operands and results (both already counted in the caller's scan):
+        the sub-frame's peak minus its I/O, clamped at zero. ``scan`` and
+        ``while`` bodies count once — the carry/working buffers are reused
+        across iterations, which is exactly the reuse the streaming eval
+        scorer's O(chunk) memory contract relies on."""
+        name = eqn.primitive.name
+        if name == "pallas_call":
+            return 0  # scoped VMEM, not HBM (fits_vmem owns that budget)
+        interior = 0
+        if name == "cond":
+            return max((self._sub_transient(b, path, rec)
+                        for b in eqn.params["branches"]), default=0)
+        for _, sub in sub_jaxprs(eqn):
+            interior += self._sub_transient(sub, path, rec)
+        return interior
+
+    def _sub_transient(self, sub: Any, path: str, rec: CostRecord) -> int:
+        j = open_jaxpr(sub)
+        io = sum(aval_bytes(v.aval) for v in
+                 list(j.invars) + list(j.constvars)) + \
+            sum(aval_bytes(v.aval) for v in j.outvars if hasattr(v, "aval"))
+        return max(0, self._frame_peak(sub, path, rec) - io)
+
+
+# ---------------------------------------------------------------------------
+# per-primitive FLOP models
+# ---------------------------------------------------------------------------
+
+def _shape(v) -> Tuple[int, ...]:
+    return tuple(getattr(getattr(v, "aval", None), "shape", ()) or ())
+
+
+def _eqn_io_bytes(eqn) -> float:
+    return float(sum(aval_bytes(v.aval) for v in eqn.invars
+                     if hasattr(v, "aval"))
+                 + sum(aval_bytes(v.aval) for v in eqn.outvars
+                       if hasattr(v, "aval")))
+
+
+def _dot_general_flops(eqn) -> float:
+    """2 FLOPs per MAC from the dimension numbers — the same convention as
+    utils/flops.py's analytic tables (the reconciliation tests pin the two
+    equal on the flagship programs)."""
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = _shape(eqn.invars[0]), _shape(eqn.invars[1])
+    batch = math.prod(lhs[a] for a in lb) if lb else 1
+    contract = math.prod(lhs[a] for a in lc) if lc else 1
+    m = math.prod(lhs[a] for a in range(len(lhs))
+                  if a not in lc and a not in lb)
+    n = math.prod(rhs[a] for a in range(len(rhs))
+                  if a not in rc and a not in rb)
+    return 2.0 * batch * m * n * contract
+
+
+def _conv_flops(eqn) -> float:
+    """2 * output elements * kernel taps * in-features / groups."""
+    out = math.prod(_shape(eqn.outvars[0]))
+    rhs = _shape(eqn.invars[1])
+    dn = eqn.params["dimension_numbers"]
+    rhs_spec = getattr(dn, "rhs_spec", None)
+    if rhs_spec is None or not rhs:
+        return 2.0 * out  # unknown layout: honest minimum
+    taps = math.prod(rhs[a] for a in rhs_spec[2:]) if len(rhs_spec) > 2 else 1
+    in_feat = rhs[rhs_spec[1]]
+    groups = eqn.params.get("feature_group_count", 1)
+    return 2.0 * out * taps * in_feat / max(groups, 1)
+
+
+def _pointwise_flops(eqn) -> float:
+    """1 FLOP per output element for compute prims, 0 for pure data
+    movement — an honest lower bound in the utils/flops.py spirit (matmuls
+    dominate; elementwise work rides along)."""
+    name = eqn.primitive.name
+    if name in _DATA_MOVEMENT:
+        return 0.0
+    if name.startswith("reduce_") or name in ("argmax", "argmin"):
+        return float(math.prod(_shape(eqn.invars[0])))
+    return float(sum(math.prod(_shape(v)) for v in eqn.outvars))
+
+
+_DATA_MOVEMENT = frozenset({
+    "broadcast_in_dim", "reshape", "transpose", "squeeze", "expand_dims",
+    "slice", "dynamic_slice", "dynamic_update_slice", "concatenate", "pad",
+    "rev", "gather", "scatter", "copy", "convert_element_type",
+    "bitcast_convert_type", "stop_gradient", "iota", "random_wrap",
+    "random_unwrap", "device_put", "split",
+})
+
+
+def _has_sub_jaxpr(eqn) -> bool:
+    return next(sub_jaxprs(eqn), None) is not None
+
+
+# ---------------------------------------------------------------------------
+# suite + registry front doors
+# ---------------------------------------------------------------------------
+
+def analyze_programs(include: Optional[List[str]] = None,
+                     blowup_factor: float = DEFAULT_BLOWUP_FACTOR
+                     ) -> Tuple[Dict[str, CostRecord], List[AuditFinding]]:
+    """Cost records + findings for the audited program suite (or a named
+    subset — unknown names raise the registry's ValueError listing the
+    valid programs, shared with ``iwae-audit --programs``)."""
+    from iwae_replication_project_tpu.analysis.audit.programs import (
+        build_programs)
+    from iwae_replication_project_tpu.telemetry.spans import span
+
+    analyzer = CostAnalyzer(blowup_factor=blowup_factor)
+    records: Dict[str, CostRecord] = {}
+    findings: List[AuditFinding] = []
+    for prog in build_programs(include):
+        with span(f"cost/{prog.name}"):
+            rec, got = analyzer.analyze(prog)
+        records[prog.name] = rec
+        findings.extend(got)
+    return records, findings
+
+
+def registry_static_costs() -> List[dict]:
+    """The live AOT registry's ``static_cost`` records (stamped by
+    utils/compile_cache at compile time) — the executable store's
+    per-entry budget inputs, surfaced through the CLI."""
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        static_cost_records)
+
+    out = []
+    for name, build_key, sig, cost in static_cost_records():
+        out.append({"name": name, "build_key": repr(build_key),
+                    "static_cost": cost})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="iwae-cost",
+        description="Jaxpr-level cost analyzer: live-range peak memory, "
+                    "FLOP/byte accounting with a roofline verdict, and "
+                    "per-mesh-axis collective profiles over the repo's "
+                    "real traced programs (trace-only — no compile).")
+    p.add_argument("--format", choices=("human", "json"), default="human")
+    p.add_argument("--programs", default=None,
+                   help="comma-separated subset of the audited programs "
+                        "(default: the full suite)")
+    p.add_argument("--blowup-factor", type=float,
+                   default=DEFAULT_BLOWUP_FACTOR,
+                   help="memory-blowup threshold: flag any intermediate "
+                        "larger than this multiple of the program's input "
+                        "bytes (default %(default)s)")
+    p.add_argument("--chip", default=None,
+                   help="device_kind substring for the roofline tables "
+                        "(default: the host TPU's kind, or v5e with the "
+                        "assumption stamped)")
+    p.add_argument("--report", default=None,
+                   help="also write the per-program cost report JSON here "
+                        "(the results/cost_report.json artifact)")
+    p.add_argument("--registry", action="store_true",
+                   help="include static_cost records of the live AOT "
+                        "registry (in-process entries only)")
+    return p
+
+
+def _report_payload(records: Dict[str, CostRecord],
+                    findings: List[AuditFinding], chip: str,
+                    chip_source: str, registry: Optional[List[dict]]
+                    ) -> dict:
+    payload = {
+        "chip": {"kind": chip, "source": chip_source},
+        "programs": {
+            name: {**rec.to_dict(), "roofline": roofline(rec, chip)}
+            for name, rec in records.items()},
+        "findings": [f.to_dict() for f in findings],
+        "counts": dict(Counter(f.rule for f in findings)),
+        "total": len(findings),
+    }
+    if registry is not None:
+        payload["registry"] = registry
+    return payload
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        # tracing may trigger tiny init compiles (model params); route them
+        # through the shared persistent cache like every other entry point
+        from iwae_replication_project_tpu.utils.compile_cache import (
+            setup_persistent_cache)
+        setup_persistent_cache(None)
+
+        include = [s.strip() for s in args.programs.split(",") if s.strip()] \
+            if args.programs else None
+        records, findings = analyze_programs(
+            include, blowup_factor=args.blowup_factor)
+        chip, chip_source = resolve_chip(args.chip)
+        registry = registry_static_costs() if args.registry else None
+        payload = _report_payload(records, findings, chip, chip_source,
+                                  registry)
+        if args.report:
+            with open(args.report, "w", encoding="utf-8") as f:
+                json.dump(payload, f, indent=2)
+                f.write("\n")
+    except (ValueError, FileNotFoundError, OSError) as e:
+        print(f"iwae-cost: error: {e}", file=sys.stderr)
+        return 2
+    except Exception:
+        print("iwae-cost: internal error:", file=sys.stderr)
+        traceback.print_exc()
+        return 2
+
+    if args.format == "json":
+        print(json.dumps(payload, indent=2))
+    else:
+        for f in findings:
+            print(f.human())
+        print(f"analyzed {len(records)} program(s) for chip {chip} "
+              f"({chip_source})")
+        hdr = (f"  {'program':<24} {'peak MB':>9} {'GFLOP':>9} "
+               f"{'matmul%':>8} {'AI (fus)':>9}  verdict / collectives")
+        print(hdr)
+        for name, rec in records.items():
+            rl = roofline(rec, chip)
+            coll = "; ".join(
+                f"{prim}[{ax}] x{int(c['count'])}"
+                for prim, axes in sorted(rec.collectives.items())
+                for ax, c in sorted(axes.items())) or "-"
+            pct = (100.0 * rec.matmul_flops / rec.flops) if rec.flops else 0.0
+            ai = rec.intensity_fused
+            print(f"  {name:<24} {rec.peak_bytes / 1e6:>9.2f} "
+                  f"{rec.flops / 1e9:>9.3f} {pct:>7.1f}% "
+                  f"{(ai if ai is not None else 0):>9.1f}  "
+                  f"{rl.get('verdict')} / {coll}")
+        if findings:
+            tally = ", ".join(
+                f"{rule}: {n}" for rule, n in
+                sorted(Counter(f.rule for f in findings).items()))
+            print(f"\n{len(findings)} finding(s) ({tally})")
+        else:
+            print("iwae-cost: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

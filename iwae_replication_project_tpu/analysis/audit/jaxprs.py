@@ -16,6 +16,16 @@ from collections import Counter
 from typing import Any, Iterator, List, Tuple
 
 
+#: cross-device communication primitives — the collective sub-histogram of
+#: :func:`signature` and the cost analyzer's per-axis accounting
+#: (analysis/audit/cost.py) share this one definition
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_gather_invariant", "all_to_all", "reduce_scatter", "pgather",
+    "pbroadcast", "psum_scatter",
+})
+
+
 @functools.lru_cache(maxsize=1)
 def core_types() -> Tuple[type, type, type, type]:
     """``(Jaxpr, ClosedJaxpr, Var, Literal)`` for the running jax version."""
@@ -76,13 +86,22 @@ def primitive_histogram(jaxpr: Any) -> Counter:
 
 def signature(jaxpr: Any) -> dict:
     """Structural fingerprint for the golden snapshot tests: total equation
-    count plus the primitive histogram. Shape-free on purpose — ``k``/batch
-    scaling changes array extents, not program structure, so the goldens stay
+    count, the primitive histogram, and the collective-primitive histogram
+    broken out on its own key. Shape-free on purpose — ``k``/batch scaling
+    changes array extents, not program structure, so the goldens stay
     stable across problem sizes and only genuine program drift (new
-    primitives, changed composition) trips them."""
+    primitives, changed composition) trips them.
+
+    The ``collectives`` sub-histogram repeats information already in
+    ``primitives`` deliberately: the sharded score program's merge contract
+    is exactly ONE ``pmax`` + ONE ``psum`` (PR 9), and an extra reshard
+    must fail CI as a *named* collective drift, not as a mystery +1 in a
+    200-entry histogram diff — cost drift should read as cost drift."""
     hist = primitive_histogram(jaxpr)
     return {"eqn_count": int(sum(hist.values())),
-            "primitives": {name: int(n) for name, n in sorted(hist.items())}}
+            "primitives": {name: int(n) for name, n in sorted(hist.items())},
+            "collectives": {name: int(n) for name, n in sorted(hist.items())
+                            if name in COLLECTIVE_PRIMS}}
 
 
 def outer_avals(closed_jaxpr: Any) -> List[Any]:

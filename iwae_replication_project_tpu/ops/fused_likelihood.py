@@ -135,8 +135,11 @@ def kernel_usable(k: int, b: int, hdim: int, n_pixels: int, *,
     `dtype` is the dtype of the streamed operands (``y``/w/bias/x — the probe
     compiles exactly that variant, and the cache keys on it).
     """
+    from iwae_replication_project_tpu.utils.dtypes import byte_width
+
     dtype = jnp.dtype(dtype)
-    if not fits_vmem(k, b, hdim, n_pixels, grad=grad, itemsize=dtype.itemsize):
+    if not fits_vmem(k, b, hdim, n_pixels, grad=grad,
+                     itemsize=byte_width(dtype)):
         return False
     if interpret:
         return True

@@ -72,6 +72,13 @@ _counters = {
 #: the AOT executable registry: signature -> jax.stages.Compiled
 _executables: dict = {}
 
+#: static cost record per registry entry (same key), stamped at compile
+#: time by the trace-only analyzer (analysis/audit/cost.py): peak HBM
+#: bytes, FLOPs, arithmetic intensity — the capacity-bounded executable
+#: store's per-entry budget inputs (ROADMAP item 1). None when tracing
+#: failed or ``IWAE_STATIC_COST=off`` disabled the stamp.
+_static_costs: dict = {}
+
 
 # ---------------------------------------------------------------------------
 # persistent compilation cache
@@ -254,6 +261,74 @@ def registry_signatures() -> list:
                 for (name, build_key, sig) in _executables]
 
 
+def static_cost_records() -> list:
+    """``(name, build_key, signature, static_cost | None)`` per executable.
+
+    ``static_cost`` is the trace-time cost record (peak HBM bytes, FLOPs,
+    arithmetic intensity, per-axis collective counts, plus ``arg_bytes``
+    sized from the dispatch signature itself) — what a capacity-bounded
+    executable store budgets its LRU eviction with, and what ``iwae-cost
+    --registry`` surfaces. Entries stamped None mean the fail-soft trace
+    was skipped (``IWAE_STATIC_COST=off``) or failed.
+    """
+    with _lock:
+        return [(*key, _static_costs.get(key)) for key in _executables]
+
+
+def _signature_arg_bytes(sig) -> int:
+    """Total dispatch-argument HBM bytes from one signature record, sized
+    through the shared ``utils.dtypes`` byte-width table (the leaf grammar
+    is :func:`_abstract_signature`'s: array leaves are 4-tuples carrying a
+    dtype *string*; scalar/kwarg-name leaves carry no buffer)."""
+    import math
+
+    from iwae_replication_project_tpu.utils.dtypes import byte_width
+
+    _, leaves = sig
+    total = 0
+    for leaf in leaves:
+        if len(leaf) >= 4:
+            shape, dtype = leaf[0], leaf[1]
+            try:
+                total += int(math.prod(shape)) * byte_width(dtype)
+            except ValueError:
+                pass  # an exotic dtype string: skip, never crash dispatch
+    return total
+
+
+def _trace_static_cost(name: str, jitted_fn: Callable, args: Tuple,
+                       kwargs: dict, static_kwargs: Optional[dict],
+                       sig) -> Optional[dict]:
+    """Stamp a registry entry's static cost record at compile time.
+
+    Trace-only (``jax.make_jaxpr`` — no second compile) and strictly
+    fail-soft: a miss already pays seconds of XLA compile, so the extra
+    trace is noise there, but ANY analyzer failure must degrade to a None
+    record rather than poison the dispatch path. ``IWAE_STATIC_COST=off``
+    disables the stamp wholesale.
+    """
+    flag = os.environ.get("IWAE_STATIC_COST")
+    if flag is not None and flag.strip().lower() in _OFF:
+        return None
+    try:
+        import functools
+
+        import jax
+
+        from iwae_replication_project_tpu.analysis.audit.cost import (
+            CostAnalyzer)
+        fn = functools.partial(jitted_fn, **(static_kwargs or {}))
+        closed = jax.make_jaxpr(fn)(*args, **kwargs)
+        rec, _ = CostAnalyzer().analyze_jaxpr(name, closed)
+        cost = rec.to_dict()
+        cost["arg_bytes"] = _signature_arg_bytes(sig)
+        return cost
+    except Exception:
+        # fail-soft by contract: a cost-stamp failure must never break the
+        # serving dispatch path; the entry simply carries no record
+        return None
+
+
 def _registry_get_or_compile(name: str, jitted_fn: Callable, args: Tuple,
                              kwargs: dict, static_kwargs: Optional[dict],
                              build_key: Tuple, count_hit: bool):
@@ -268,8 +343,14 @@ def _registry_get_or_compile(name: str, jitted_fn: Callable, args: Tuple,
         t0 = time.perf_counter()
         lowered = jitted_fn.lower(*args, **kwargs, **(static_kwargs or {}))
         exe = lowered.compile()
+        # compile already cost seconds; the trace-only cost stamp rides the
+        # miss (fail-soft, IWAE_STATIC_COST=off to disable)
+        cost = _trace_static_cost(name, jitted_fn, args, kwargs,
+                                  static_kwargs, key[2])
         with _lock:
             _executables[key] = exe
+            if cost is not None:
+                _static_costs[key] = cost
             _counters["aot_misses"] += 1
             _counters["aot_compile_seconds"] += time.perf_counter() - t0
     elif count_hit:
@@ -387,13 +468,17 @@ def isolated_aot_registry():
     """
     with _lock:
         saved = dict(_executables)
+        saved_costs = dict(_static_costs)
         _executables.clear()
+        _static_costs.clear()
     try:
         yield
     finally:
         with _lock:
             _executables.clear()
             _executables.update(saved)
+            _static_costs.clear()
+            _static_costs.update(saved_costs)
 
 
 # ---------------------------------------------------------------------------

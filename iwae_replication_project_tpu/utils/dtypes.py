@@ -1,0 +1,77 @@
+"""The ONE dtype -> byte-width mapping (ISSUE 11 satellite).
+
+Three independent consumers previously spelled this out ad hoc — the fused
+kernel's VMEM probe read ``dtype.itemsize`` directly, the AOT registry's
+signature records carried dtype *strings* with no way back to bytes, and the
+cost analyzer (analysis/audit/cost.py) needs bytes for every aval it sizes.
+One table, shared, so "how many bytes is a bf16 row" has exactly one answer
+in the codebase:
+
+* :func:`byte_width` — bytes per element for anything dtype-shaped: a numpy
+  dtype, a jax/aval dtype (including the extended PRNG-key dtypes, sized by
+  their uint32 lanes), a dtype *string* as stored in
+  ``compile_cache._abstract_signature`` records, or a weak-typed python
+  scalar's inferred dtype (plain ``int``/``float``/``bool``/``complex``
+  names map to the x64-off production widths: i32/f32/bool/c64);
+* :func:`aval_bytes` — total buffer bytes of one abstract value.
+
+Production numerics are x64-off bf16/f32 (the dtype-promotion lint rule),
+so the table is small and explicit; anything unrecognized falls back to
+``numpy.dtype`` rather than guessing.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+#: canonical dtype-name -> bytes per element. Covers the production set
+#: (f32/bf16/i32/bool + the RNG plumbing's unsigned ints) plus the python
+#: scalar names weak-typed leaves carry under x64-off promotion rules.
+BYTE_WIDTHS = {
+    "bool": 1, "int8": 1, "uint8": 1,
+    "float8_e4m3fn": 1, "float8_e5m2": 1,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
+    "complex128": 16,
+    # weak-typed python scalars at x64-off (the signature-record leaf
+    # grammar stores these as type names)
+    "int": 4, "float": 4, "complex": 8,
+}
+
+
+def byte_width(dtype: Any) -> int:
+    """Bytes per element of `dtype` (dtype object, aval dtype, or name).
+
+    JAX's extended PRNG-key dtypes (``key<fry>`` etc.) size as their
+    underlying uint32 lanes — the bytes the buffer actually occupies.
+    """
+    name = dtype if isinstance(dtype, str) else getattr(dtype, "name", None)
+    if name is not None:
+        w = BYTE_WIDTHS.get(str(name))
+        if w is not None:
+            return w
+    # extended dtypes (PRNG keys): the impl declares its uint32 key lanes
+    impl = getattr(dtype, "_impl", None)
+    key_shape = getattr(impl, "key_shape", None)
+    if key_shape is not None:
+        return int(math.prod(key_shape)) * 4
+    import numpy as np
+
+    try:
+        return int(np.dtype(dtype).itemsize)
+    except TypeError:
+        itemsize = getattr(dtype, "itemsize", None)
+        if itemsize:
+            return int(itemsize)
+        raise ValueError(f"no byte width known for dtype {dtype!r}")
+
+
+def aval_bytes(aval: Any) -> int:
+    """Total buffer bytes of one abstract value (0 for shapeless tokens)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    return int(math.prod(shape)) * byte_width(dtype)

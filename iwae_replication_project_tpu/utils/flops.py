@@ -35,6 +35,19 @@ PEAK_BF16_FLOPS: Tuple[Tuple[str, float], ...] = (
 )
 
 
+#: per-chip HBM bandwidth (bytes/s) by ``device_kind`` substring, matched in
+#: the same order discipline as the FLOPs table. Sources: Google's published
+#: per-chip specs — v2 700GB/s, v3 900GB/s, v4 1228GB/s, v5e 819GB/s,
+#: v5p 2765GB/s, v6e/Trillium 1640GB/s. The roofline ridge point
+#: (peak_flops / hbm_bytes_per_s) is what the static cost analyzer
+#: (analysis/audit/cost.py) compares arithmetic intensity against.
+PEAK_HBM_BYTES: Tuple[Tuple[str, float], ...] = (
+    ("v6e", 1640e9), ("v6 lite", 1640e9), ("trillium", 1640e9),
+    ("v5p", 2765e9), ("v5e", 819e9), ("v5 lite", 819e9),
+    ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+)
+
+
 def peak_flops_for_kind(kind: str) -> Tuple[Optional[float], str]:
     """``(peak_flops | None, source)`` for one ``device_kind`` string."""
     low = kind.lower()
@@ -42,6 +55,50 @@ def peak_flops_for_kind(kind: str) -> Tuple[Optional[float], str]:
         if sub in low:
             return val, f"bf16 peak table: matched {sub!r} in device_kind {kind!r}"
     return None, f"no peak-FLOPs table entry for device_kind {kind!r}"
+
+
+def peak_hbm_bytes_for_kind(kind: str) -> Tuple[Optional[float], str]:
+    """``(hbm_bytes_per_s | None, source)`` for one ``device_kind`` string."""
+    low = kind.lower()
+    for sub, val in PEAK_HBM_BYTES:
+        if sub in low:
+            return val, f"HBM BW table: matched {sub!r} in device_kind {kind!r}"
+    return None, f"no HBM-bandwidth table entry for device_kind {kind!r}"
+
+
+def param_count(cfg) -> int:
+    """Exact parameter count of the architecture (weights + biases), derived
+    from the same block structure as the MAC tables above."""
+
+    def stochastic(in_dim, hidden, latent):
+        return (stochastic_block_macs(in_dim, hidden, latent)
+                + 2 * hidden + 2 * latent)
+
+    L = cfg.n_stochastic
+    n = stochastic(cfg.x_dim, cfg.n_hidden_enc[0], cfg.n_latent_enc[0])
+    in_dim = cfg.n_latent_enc[0]
+    for i in range(1, L):
+        n += stochastic(in_dim, cfg.n_hidden_enc[i], cfg.n_latent_enc[i])
+        in_dim = cfg.n_latent_enc[i]
+    in_dim = cfg.n_latent_enc[-1]
+    for i in range(L - 1):
+        n += stochastic(in_dim, cfg.n_hidden_dec[i], cfg.n_latent_dec[i])
+        in_dim = cfg.n_latent_dec[i]
+    n += (output_block_macs(in_dim, cfg.n_hidden_dec[-1], cfg.x_dim)
+          + 2 * cfg.n_hidden_dec[-1] + cfg.x_dim)
+    return n
+
+
+def model_param_bytes(cfg, dtype="float32") -> int:
+    """HBM bytes of one parameter pytree — the resident floor every program
+    in the suite pays before a single activation (the train step pays 3x:
+    params + both Adam moments). `dtype` resolves through the shared
+    ``utils.dtypes`` byte-width table (params are f32 in production; a
+    bf16 zoo entry halves this). Cross-checked bit-exactly against the
+    traced train step's input bytes in tests/test_cost.py, and stamped
+    into bench.py's static-cost block."""
+    from iwae_replication_project_tpu.utils.dtypes import byte_width
+    return param_count(cfg) * byte_width(dtype)
 
 
 def largest_divisor_leq(n: int, cap: int) -> int:

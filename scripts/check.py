@@ -1,4 +1,4 @@
-"""The single CI gate: lint -> audit -> smokes -> tier-1, with a
+"""The single CI gate: lint -> audit -> cost -> smokes -> tier-1, with a
 machine-readable summary.
 
 ``python scripts/check.py`` runs, in order:
@@ -11,23 +11,28 @@ machine-readable summary.
    donation safety, padding taint, in-graph host transfers, and recompile
    cardinality over the repo's real traced programs (train step, k=5000
    eval scorer, the three serving programs, all hot-loop paths);
-3. **telemetry smoke** (scripts/telemetry_smoke.py);
-4. **serving smoke** (scripts/serving_smoke.py);
-5. **serving tier smoke** (scripts/serving_tier_smoke.py) — the network
+3. **iwae-cost** (analysis/audit/cost.py) — the jaxpr-level cost analyzer
+   over the same traced suite: live-range peak HBM bytes, FLOP/byte
+   roofline accounting, and per-mesh-axis collective profiles, writing
+   the committed ``results/cost_report.json`` (memory-blowup and
+   accidental-allgather findings fail the gate like lint findings);
+4. **telemetry smoke** (scripts/telemetry_smoke.py);
+5. **serving smoke** (scripts/serving_smoke.py);
+6. **serving tier smoke** (scripts/serving_tier_smoke.py) — the network
    tier over a real socket with a replica killed mid-burst: zero lost
    responses, zero recompiles, bitwise parity with a direct engine;
-6. **large-k smoke** (scripts/large_k_smoke.py) — a k=5000 score request
+7. **large-k smoke** (scripts/large_k_smoke.py) — a k=5000 score request
    through the warm mesh-backed engine: bitwise parity with the offline
    ``parallel/eval`` scorer and zero recompiles over a ragged (batch, k)
    stream;
-7. **hot-loop smoke** (scripts/hot_loop_smoke.py);
-8. **chaos smoke** (scripts/chaos_smoke.py) — the failure model under a
+8. **hot-loop smoke** (scripts/hot_loop_smoke.py);
+9. **chaos smoke** (scripts/chaos_smoke.py) — the failure model under a
    seeded fault schedule: replica crash + AOT fault + dropped connection
    vs a retrying client (bitwise parity, zero lost futures), a slow
    replica beaten by a client hedge, SIGTERM-mid-stage + resume and
    truncated-checkpoint fallback both bitwise-identical to an
    uninterrupted run; summary committed to ``results/chaos_smoke.json``;
-9. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
+10. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
    ``--sanitize`` armed.
 
 Every full-gate run writes ``results/check_summary.json`` (per-stage status,
@@ -77,7 +82,7 @@ def classify_analyzer_rc(rc: int) -> str:
     return "internal-error"
 
 
-def run_analyzer(label: str, module: str) -> dict:
+def run_analyzer(label: str, module: str, extra_args=()) -> dict:
     """Run a findings-producing CLI with ``--format json``, classify its
     exit code, and re-print its findings human-readably.
 
@@ -90,7 +95,7 @@ def run_analyzer(label: str, module: str) -> dict:
     print(f"== {label} ".ljust(72, "="))
     t0 = time.perf_counter()
     proc = subprocess.run(
-        [sys.executable, "-m", module, "--format", "json"],
+        [sys.executable, "-m", module, "--format", "json", *extra_args],
         cwd=REPO, capture_output=True, text=True)
     wall = time.perf_counter() - t0
     status = classify_analyzer_rc(proc.returncode)
@@ -136,6 +141,17 @@ def run_lint() -> dict:
 
 def run_audit() -> dict:
     return run_analyzer("audit", "iwae_replication_project_tpu.analysis.audit")
+
+
+def run_cost() -> dict:
+    """The iwae-cost stage: same exit-code classification as lint/audit
+    (0 clean / 1 findings / anything else = analyzer crash), plus the
+    committed per-program cost report — peak HBM bytes, FLOPs, arithmetic
+    intensity, and per-mesh-axis collective counts — so cost drift diffs
+    across PRs exactly like finding counts do."""
+    return run_analyzer(
+        "cost", "iwae_replication_project_tpu.analysis.audit.cost",
+        extra_args=("--report", os.path.join("results", "cost_report.json")))
 
 
 def run_telemetry_smoke() -> dict:
@@ -196,7 +212,7 @@ def main(argv=None) -> int:
         argv, passthrough = argv[:split], argv[split + 1:]
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--lint-only", action="store_true",
-                    help="static analyzers only (lint + audit)")
+                    help="static analyzers only (lint + audit + cost)")
     ap.add_argument("--tests-only", action="store_true")
     ap.add_argument("--summary", default=None,
                     help="where to write the machine-readable stage summary "
@@ -210,6 +226,7 @@ def main(argv=None) -> int:
     if not args.tests_only:
         stages.append(run_lint())
         stages.append(run_audit())
+        stages.append(run_cost())
     if not single_stage:
         stages.append(run_telemetry_smoke())
         stages.append(run_serving_smoke())

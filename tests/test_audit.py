@@ -640,7 +640,8 @@ class TestCheckSummary:
         assert r.returncode == 0, r.stdout + r.stderr
         payload = json.loads(out.read_text())
         assert payload["ok"] is True
-        assert [s["name"] for s in payload["stages"]] == ["lint", "audit"]
+        assert [s["name"] for s in payload["stages"]] == \
+            ["lint", "audit", "cost"]
         for s in payload["stages"]:
             assert s["status"] == "ok" and s["findings"] == 0
             assert s["wall_seconds"] > 0
