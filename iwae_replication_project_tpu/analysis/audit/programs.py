@@ -30,6 +30,7 @@ PROGRAM_NAMES = (
     "serve_score",
     "serve_encode",
     "serve_decode",
+    "serve_score_fused",
     "serve_score_sharded",
     "hot_loop_reference",
     "hot_loop_blocked_scan",
@@ -154,6 +155,50 @@ def build_serving(op: str) -> AuditProgram:
                    tuple(sorted(kwargs.items(), key=lambda kv: kv[0]))), {}))
 
 
+def build_serving_fused(path: str = "blocked_scan") -> AuditProgram:
+    """The UNPINNED serving score program (ISSUE 12): the same row-vmapped
+    composition as ``serve_score``, under the dispatch config the lifted
+    engine gate bakes when the probe admits a fused path — here the
+    blocked-scan pin, which traces identically on every host (the pallas
+    pin's kernel interior is opaque to the taint pass anyway) and routes
+    the per-row decoder block through the remat'd hot-loop dispatcher the
+    padding-taint pass must prove clean."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from iwae_replication_project_tpu.serving.programs import (
+        PADDED_ROW_KWARGS,
+        PROGRAMS,
+    )
+
+    cfg, state = _model_state()
+    # the engine gate's fused dispatch config (serving/engine._resolve_kernel)
+    cfg = _dc.replace(cfg, likelihood="logits", fused_likelihood=True,
+                      hot_loop_path=path)
+    program, _ = PROGRAMS["score"]
+    bucket, real = 8, 5
+    base_key = jax.random.PRNGKey(2)
+    seeds = jnp.zeros((bucket,), jnp.int32)
+    payload = jnp.zeros((bucket, cfg.x_dim), jnp.float32)
+    kwargs = {"base_key": base_key, "seeds": seeds, "x": payload}
+    static = {"cfg": cfg, "k": 4}
+
+    def fn(params, base_key, seeds, payload):
+        return program(params, base_key=base_key, seeds=seeds, x=payload,
+                       **static)
+
+    args = (state.params, base_key, seeds, payload)
+    tainted = [kwargs[name] for name in PADDED_ROW_KWARGS["score_fused"]]
+    return AuditProgram(
+        name="serve_score_fused",
+        jaxpr=jax.make_jaxpr(fn)(*args),
+        taints=_taint_indices(args, tainted, {0: real}),
+        sig_args=(((state.params,),
+                   tuple(sorted(kwargs.items(), key=lambda kv: kv[0]))), {}))
+
+
 def build_serving_sharded() -> AuditProgram:
     """The mesh-sharded dynamic-k score program (ShardedScoreEngine's
     dispatch) at a padded bucket: bucket 8 holding 5 real rows on a 1x1
@@ -233,6 +278,7 @@ def build_programs(include: Optional[Sequence[str]] = None
         "serve_score": lambda: build_serving("score"),
         "serve_encode": lambda: build_serving("encode"),
         "serve_decode": lambda: build_serving("decode"),
+        "serve_score_fused": build_serving_fused,
         "serve_score_sharded": build_serving_sharded,
         "hot_loop_reference": lambda: build_hot_loop("reference"),
         "hot_loop_blocked_scan": lambda: build_hot_loop("blocked_scan"),

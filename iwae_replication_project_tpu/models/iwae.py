@@ -60,6 +60,17 @@ class ModelConfig:
     # scan or the unfused composition. Requires likelihood="logits".
     # (ops/hot_loop.py; ops/fused_likelihood.py is the k-only predecessor)
     fused_likelihood: bool = False
+    # Trace-time pin of the hot-loop implementation ("pallas" |
+    # "blocked_scan" | "reference"; None = the dispatcher's auto selection).
+    # The serving engines resolve the probe-gated selection OUTSIDE the
+    # trace — once per (op, bucket, k), ops/hot_loop.serving_select_path —
+    # and bake the outcome here, so the traced program is deterministic,
+    # the AOT registry keys on it (cfg rides every build key), and the
+    # per-row kernel_path stamps recompute it exactly. hot_loop_tile pins
+    # the pallas (tk, tb) tile alongside (gate/autotuner-validated; the
+    # trace then skips re-selection and re-probing entirely).
+    hot_loop_path: Optional[str] = None
+    hot_loop_tile: Optional[Tuple[int, int]] = None
 
     def __post_init__(self):
         L = self.n_stochastic
@@ -72,6 +83,27 @@ class ModelConfig:
             raise ValueError(f"unknown likelihood {self.likelihood!r}")
         if self.fused_likelihood and self.likelihood != "logits":
             raise ValueError("fused_likelihood requires likelihood='logits'")
+        if self.hot_loop_path is not None:
+            if self.hot_loop_path not in ("pallas", "blocked_scan",
+                                          "reference"):
+                raise ValueError(f"unknown hot_loop_path "
+                                 f"{self.hot_loop_path!r}")
+            if not self.fused_likelihood:
+                raise ValueError("hot_loop_path is a pin on the fused "
+                                 "dispatcher; it requires "
+                                 "fused_likelihood=True")
+        if self.hot_loop_tile is not None:
+            if self.hot_loop_path != "pallas":
+                raise ValueError("hot_loop_tile requires "
+                                 "hot_loop_path='pallas'")
+            t = tuple(self.hot_loop_tile)
+            if len(t) != 2 or any(int(v) < 1 for v in t):
+                raise ValueError(f"hot_loop_tile must be two positive ints, "
+                                 f"got {self.hot_loop_tile!r}")
+            # normalize to a hashable tuple of ints (hashability is what
+            # lets the config ride jit statics and AOT build keys)
+            object.__setattr__(self, "hot_loop_tile",
+                               (int(t[0]), int(t[1])))
 
     @property
     def n_stochastic(self) -> int:
@@ -185,7 +217,9 @@ def log_px_given_h(params: Params, cfg: ModelConfig, x: jax.Array,
         from iwae_replication_project_tpu.ops import hot_loop
         return hot_loop.decoder_score(params["out"], x, h1,
                                       compute_dtype=cfg.matmul_dtype,
-                                      on_tpu=_on_tpu())
+                                      on_tpu=_on_tpu(),
+                                      force_path=cfg.hot_loop_path,
+                                      force_tile=cfg.hot_loop_tile)
     logits = decode_logits(params, cfg, h1)
     if cfg.likelihood == "clamp":
         probs = dist.clamp_probs(jax.nn.sigmoid(logits))

@@ -51,6 +51,23 @@ Env levers (all read at trace/selection time):
   HBM absorbs the reference path at r05 behavior);
 * ``IWAE_FUSED_VMEM_BUDGET`` — shared with ops.fused_likelihood: the
   scoped-VMEM budget the tile estimates are held to.
+
+Two later layers compose with the selection machinery here:
+
+* **the serving gate** (:func:`serving_select_path`) — the serving programs
+  (serving/programs.py) are row-vmapped per-request compositions, so their
+  kernel shape is ``(k, 1)`` per row with the bucket as the vmap axis. The
+  engine resolves the gate OUTSIDE the trace, once per (op, bucket, k),
+  probe-compiling the actual row-vmapped kernel, and bakes the outcome into
+  the dispatch config (``ModelConfig.hot_loop_path``/``hot_loop_tile``) so
+  the traced program is deterministic and falls back to the reference
+  (previously pinned) path whenever the probe rejects the shape;
+* **the measured autotuner** (ops/autotune.py) — persisted per
+  (shape, compute dtype, chip generation, VMEM budget) winners, consulted
+  by :func:`kernel_usable_block` (tile override), :func:`_scan_block_k`
+  (remat slab override), and :func:`serving_select_path` (path + tile).
+  Consultation is passive and fail-soft: no winner cache, or a corrupt
+  one, selects exactly what the hand-picked heuristics select today.
 """
 
 from __future__ import annotations
@@ -121,7 +138,9 @@ def path_code_for_model(cfg, k: int, batch: int, *, on_tpu: bool) -> float:
     results cached, so recomputing it here matches what a trace of the same
     shape bakes in — without depending on trace ORDER the way the
     ``kernel_path`` gauge does (a jit-cache-hit dispatch traces nothing and
-    would otherwise stamp whichever unrelated program traced last).
+    would otherwise stamp whichever unrelated program traced last). A config
+    carrying a ``hot_loop_path`` pin (the serving engines' dispatch configs)
+    stamps the pin — that IS what the trace bakes in.
     `cfg` is duck-typed on the ModelConfig fields (ops/ must not import
     models/).
     """
@@ -133,7 +152,9 @@ def path_code_for_model(cfg, k: int, batch: int, *, on_tpu: bool) -> float:
     path, _ = select_path(k, batch, h1_dim, cfg.n_hidden_dec[-1], cfg.x_dim,
                           on_tpu=on_tpu,
                           compute_dtype=None if cd is None
-                          else jnp.dtype(cd).name)
+                          else jnp.dtype(cd).name,
+                          force=getattr(cfg, "hot_loop_path", None),
+                          force_tile=getattr(cfg, "hot_loop_tile", None))
     return float(PATH_CODES[path])
 
 
@@ -200,6 +221,37 @@ def select_block(k: int, b: int, h1_dim: int, hid: int, n_pixels: int,
     return None
 
 
+def tile_admissible(tk: int, tb: int, k: int, b: int) -> bool:
+    """Mosaic-shape admissibility of a candidate ``(tk, tb)`` out-tile:
+    tk is the sublane dim (multiples of 8, or all of k when k < 8), tb the
+    lane dim (a multiple of 128, or >= the full batch — after padding a
+    tb >= b tile IS the full dim, Mosaic's full-dim exemption). The one
+    rule shared by the hand-picked heuristic, the autotuner's candidate
+    generator, and the winner-cache validation below — a persisted tile
+    from another version can never smuggle an un-tileable shape in."""
+    if tk < 1 or tb < 1 or tk > max(k, 8):
+        return False
+    if tk % 8 != 0 and tk != k:
+        return False
+    if tb % 128 != 0 and tb < b:
+        return False
+    return True
+
+
+def _autotune_winner(kind: str, k: int, b: int, h1_dim: int, hid: int,
+                     n_pixels: int, compute_dtype) -> Optional[dict]:
+    """Measured winner for this shape from the persistent autotune cache
+    (ops/autotune.py), or None. Strictly fail-soft: selection must keep
+    working — on the hand-picked heuristics — when the cache is absent,
+    corrupt (autotune warns loudly itself), or the module cannot load."""
+    try:
+        from iwae_replication_project_tpu.ops import autotune
+        return autotune.winner_for(kind, k, b, h1_dim, hid, n_pixels,
+                                   compute_dtype)
+    except Exception:
+        return None
+
+
 _probe_cache: dict = {}
 
 
@@ -215,8 +267,22 @@ def kernel_usable_block(k: int, b: int, h1_dim: int, hid: int, n_pixels: int,
     the enclosing jit. Interpret mode (CPU tests) has no scoped-VMEM limit,
     so the estimate alone decides. The probe cache is keyed on the effective
     budget so a mid-process ``IWAE_FUSED_VMEM_BUDGET`` change re-probes.
+
+    A measured autotune winner (ops/autotune.py) overrides the hand-picked
+    tile when one is persisted for this exact shape/dtype/chip/budget — but
+    only after re-validating admissibility and the live VMEM estimate, so a
+    stale cache can at worst cost a fallback, never an oversized compile.
     """
-    block = select_block(k, b, h1_dim, hid, n_pixels, grad=grad)
+    block = None
+    win = _autotune_winner("bwd" if grad else "fwd", k, b, h1_dim, hid,
+                           n_pixels, compute_dtype)
+    if win is not None and win.get("path") == "pallas" and win.get("tile"):
+        tk, tb = (int(v) for v in win["tile"])
+        if tile_admissible(tk, tb, k, b) and \
+                fits_vmem_block(tk, tb, h1_dim, hid, n_pixels, grad=grad):
+            block = (tk, tb)
+    if block is None:
+        block = select_block(k, b, h1_dim, hid, n_pixels, grad=grad)
     if block is None:
         return None
     if interpret:
@@ -258,6 +324,174 @@ def _probe_compiles(k, b, h1_dim, hid, n_pixels, grad, compute_dtype,
             f"path for this shape ({type(e).__name__}: {str(e)[:200]})",
             RuntimeWarning, stacklevel=3)
         return False
+
+
+# --------------------------------------------------------------------------
+# The serving gate: the row-vmapped composition (ROADMAP item 3)
+# --------------------------------------------------------------------------
+
+def _probe_compiles_vmapped(k, rows, h1_dim, hid, n_pixels, compute_dtype,
+                            block) -> bool:
+    """One probe compile of the ROW-VMAPPED forward kernel — the actual
+    Mosaic composition the serving programs dispatch (`vmap` lifts the
+    request axis into the pallas grid), which the unbatched probe in
+    :func:`_probe_compiles` cannot vouch for."""
+    import warnings
+    s = jax.ShapeDtypeStruct
+    f32 = jnp.float32
+    tk, tb = block
+    fn = functools.partial(_fwd_pallas, tk=tk, tb=tb, interpret=False,
+                           compute_dtype=compute_dtype)
+    vf = jax.vmap(fn, in_axes=(0, None, None, None, None, None, None, 0))
+    args = (s((rows, k, 1, h1_dim), f32), s((h1_dim, hid), f32),
+            s((hid,), f32), s((hid, hid), f32), s((hid,), f32),
+            s((hid, n_pixels), f32), s((n_pixels,), f32),
+            s((rows, 1, n_pixels), f32))
+    try:
+        jax.jit(vf).lower(*args).compile()
+        return True
+    except Exception as e:  # Mosaic batching limits, scoped-vmem overflow...
+        warnings.warn(
+            f"row-vmapped hot-loop kernel failed to compile for serving "
+            f"shape k={k} rows={rows} h1={h1_dim} hid={hid} d={n_pixels} "
+            f"tile={block} on {jax.devices()[0].device_kind!r}; serving "
+            f"keeps the reference path for this bucket "
+            f"({type(e).__name__}: {str(e)[:200]})",
+            RuntimeWarning, stacklevel=3)
+        return False
+
+
+def serving_kernel_usable(k: int, rows: int, h1_dim: int, hid: int,
+                          n_pixels: int, *, interpret: bool = False,
+                          compute_dtype=None,
+                          tile: Optional[Tuple[int, int]] = None
+                          ) -> Optional[Tuple[int, int]]:
+    """Probe gate for the serving composition: per-row ``(tk, 1)`` tiles,
+    vmapped over `rows` requests. Same estimate-then-probe contract as
+    :func:`kernel_usable_block` (probe cached per shape + budget; a compile
+    failure warns once and permanently selects the fallback), with the
+    probe compiling the *vmapped* kernel. `tile` proposes a (tk, 1) tile
+    (an autotune winner); inadmissible proposals fall back to the default
+    K-slab."""
+    tk = None
+    if tile is not None:
+        t0, t1 = (int(v) for v in tile)
+        if t1 == 1 and tile_admissible(t0, 1, k, 1):
+            tk = t0
+    if tk is None:
+        tk = min(TILE_K, k)
+    block = (tk, 1)
+    if not fits_vmem_block(tk, 1, h1_dim, hid, n_pixels, grad=False):
+        return None
+    if interpret:
+        return block
+    key = ("serving", k, rows, h1_dim, hid, n_pixels, str(compute_dtype),
+           block, _vmem_budget())
+    hit = _probe_cache.get(key)
+    if hit is None:
+        hit = _probe_compiles_vmapped(k, rows, h1_dim, hid, n_pixels,
+                                      compute_dtype, block)
+        _probe_cache[key] = hit
+    return block if hit else None
+
+
+def serving_select_path(k: int, rows: int, h1_dim: int, hid: int,
+                        n_pixels: int, *, on_tpu: bool, compute_dtype=None,
+                        force: Optional[str] = None
+                        ) -> Tuple[str, Optional[Tuple[int, int]]]:
+    """``(path, tile_or_None)`` for the row-vmapped serving composition at
+    one (bucket=`rows`, `k`).
+
+    The serving engines call this OUTSIDE the trace — once per
+    (op, bucket, k), results cached engine-side — and bake the outcome into
+    the dispatch config (``ModelConfig.hot_loop_path``/``hot_loop_tile``),
+    so program identity is deterministic, the AOT registry keys on it, and
+    row stamps recompute it exactly. Order mirrors :func:`select_path`:
+    force/env > persisted serving autotune winner > probe-gated pallas
+    (TPU) > scan threshold over the whole-bucket working set > reference —
+    where "reference" IS the previously pinned unfused program (the
+    automatic-fallback contract: an ineligible shape serves exactly what
+    PR 6 served).
+    """
+    from iwae_replication_project_tpu.telemetry.spans import span
+
+    forced = (force or os.environ.get("IWAE_HOT_LOOP_PATH", "auto")).lower()
+    if forced not in ("auto", "pallas", "blocked_scan", "reference"):
+        source = "force argument" if force else "IWAE_HOT_LOOP_PATH"
+        raise ValueError(
+            f"{source}={forced!r}: expected auto | pallas | "
+            f"blocked_scan | reference")
+    if forced == "auto":
+        win = _autotune_winner("serving_row", k, rows, h1_dim, hid,
+                               n_pixels, compute_dtype)
+        if win is not None:
+            path = win.get("path")
+            if path == "pallas" and on_tpu:
+                # on_tpu guard mirrors select_path's auto rule: a pallas
+                # winner (however it got into the cache) must never route
+                # CPU production through the interpreter — off-TPU it
+                # falls through to the hand-picked order below
+                block = serving_kernel_usable(
+                    k, rows, h1_dim, hid, n_pixels, interpret=False,
+                    compute_dtype=compute_dtype, tile=win.get("tile"))
+                if block is not None:
+                    return "pallas", block
+                # the winner no longer fits/compiles (budget or chip
+                # drift): fall through to the hand-picked auto order
+            elif path in ("blocked_scan", "reference"):
+                return path, None
+    if forced == "pallas" or (forced == "auto" and on_tpu):
+        with span("kernel/select/serving"):
+            block = serving_kernel_usable(k, rows, h1_dim, hid, n_pixels,
+                                          interpret=not on_tpu,
+                                          compute_dtype=compute_dtype)
+        if block is not None:
+            return "pallas", block
+        if forced == "pallas":
+            import warnings
+            warnings.warn(
+                f"serving hot-loop path forced to pallas but no tile fits "
+                f"k={k} rows={rows} h1={h1_dim} hid={hid} d={n_pixels}; "
+                f"using blocked_scan", RuntimeWarning, stacklevel=2)
+            return "blocked_scan", None
+    if forced == "blocked_scan":
+        return "blocked_scan", None
+    if forced == "reference":
+        return "reference", None
+    workset = 4.0 * k * rows * (2 * hid + n_pixels)
+    if workset > _scan_threshold(on_tpu):
+        return "blocked_scan", None
+    return "reference", None
+
+
+def serving_dispatch_config(cfg, k: int, rows: int, *, on_tpu: bool,
+                            force: Optional[str] = None) -> tuple:
+    """``(dispatch cfg, path, tile)``: resolve :func:`serving_select_path`
+    for one model at one (k, rows) and bake the outcome into the config's
+    ``hot_loop_path``/``hot_loop_tile`` pins — the ONE resolve-then-bake
+    sequence shared by the fast serving engine, the sharded scorer, and
+    the bench's direct-program legs, so the three can never drift. Every
+    ineligible model (``likelihood != "logits"``), explicit reference
+    force, and probe rejection returns `cfg` unchanged: the automatic
+    fallback IS the previously pinned program. `cfg` is duck-typed on the
+    ModelConfig fields (ops/ must not import models/); the pinned fields
+    must exist on it (they do on ModelConfig)."""
+    import dataclasses
+
+    if getattr(cfg, "likelihood", None) != "logits" or force == "reference":
+        return cfg, "reference", None
+    from iwae_replication_project_tpu.ops.autotune import dims_for_model
+    h1_dim, hid, n_pixels = dims_for_model(cfg)
+    cd = cfg.matmul_dtype
+    path, tile = serving_select_path(
+        k, rows, h1_dim, hid, n_pixels, on_tpu=on_tpu,
+        compute_dtype=None if cd is None else jnp.dtype(cd).name,
+        force=force)
+    if path == "reference":
+        return cfg, "reference", None
+    return dataclasses.replace(cfg, likelihood="logits",
+                               fused_likelihood=True, hot_loop_path=path,
+                               hot_loop_tile=tile), path, tile
 
 
 # --------------------------------------------------------------------------
@@ -484,9 +718,18 @@ def _blocked_scan_impl(h1, w1, b1, w2, b2, w3, b3, x, *, block_k: int,
     return out.reshape(k, h1.shape[1])
 
 
-def _scan_block_k(k: int, b: int, hid: int, n_pixels: int) -> int:
+def _scan_block_k(k: int, b: int, hid: int, n_pixels: int,
+                  h1_dim: int = 0, compute_dtype=None) -> int:
     """Slab height targeting ~32 MiB of slab activations: big enough to keep
-    the matmuls efficient, small enough that remat actually bounds memory."""
+    the matmuls efficient, small enough that remat actually bounds memory.
+    A persisted autotune winner for the scan kind (a measured remat point,
+    ops/autotune.py) overrides the hand-picked target when present."""
+    win = _autotune_winner("scan", k, b, h1_dim, hid, n_pixels,
+                           compute_dtype)
+    if win is not None and win.get("block_k"):
+        bk = int(win["block_k"])
+        if 1 <= bk <= k:
+            return largest_divisor_leq(k, bk)
     per_k = b * (2 * hid + n_pixels) * 4
     return max(1, min(k, (32 * 1024 * 1024) // max(per_k, 1)))
 
@@ -546,17 +789,24 @@ _fused_block_ll.defvjp(_fused_fwd, _fused_bwd)
 
 def select_path(k: int, b: int, h1_dim: int, hid: int, n_pixels: int, *,
                 on_tpu: bool, compute_dtype=None,
-                force: Optional[str] = None
+                force: Optional[str] = None,
+                force_tile: Optional[Tuple[int, int]] = None
                 ) -> Tuple[str, Optional[Tuple[int, int]]]:
     """``(path, pallas_block_or_None)`` for one hot-loop shape.
 
     Order: explicit `force` (callers that must trace ONE specific path —
     the program auditor enumerates all three without mutating the process
-    env) > env override > Pallas (probe-gated; interpret mode only when
-    forced, so CPU production never pays the interpreter) > blocked scan
-    when the materialized working set crosses the threshold > reference.
-    Runs at trace time only — the choice is baked into the compiled program,
-    so it can never cause a mid-run recompile.
+    env; the serving engines bake their probe-gated outcome in through the
+    dispatch config) > env override > a persisted autotune winner for this
+    shape (measured path choice, ops/autotune.py) > Pallas (probe-gated;
+    interpret mode only when forced, so CPU production never pays the
+    interpreter) > blocked scan when the materialized working set crosses
+    the threshold > reference. Runs at trace time only — the choice is
+    baked into the compiled program, so it can never cause a mid-run
+    recompile. `force_tile` (only with ``force="pallas"``) pins the tile
+    too, skipping re-selection and re-probing: the caller — the serving
+    gate, whose probe covers the *vmapped* composition the inner probe
+    cannot see — has already validated it.
     """
     from iwae_replication_project_tpu.telemetry.spans import span
 
@@ -566,6 +816,21 @@ def select_path(k: int, b: int, h1_dim: int, hid: int, n_pixels: int, *,
         raise ValueError(
             f"{source}={forced!r}: expected auto | pallas | "
             f"blocked_scan | reference")
+    if forced == "pallas" and force_tile is not None:
+        tk, tb = (int(v) for v in force_tile)
+        if not tile_admissible(tk, tb, k, b):
+            raise ValueError(f"forced tile {(tk, tb)} is not admissible for "
+                             f"shape k={k} b={b}")
+        return "pallas", (tk, tb)
+    if forced == "auto":
+        # a measured winner decides the path outright (it was ranked by
+        # wall time against the very alternatives below); pallas winners
+        # still pass the probe gate via their tile in kernel_usable_block
+        win = _autotune_winner("fwd", k, b, h1_dim, hid, n_pixels,
+                               compute_dtype)
+        if win is not None and win.get("path") in ("blocked_scan",
+                                                   "reference"):
+            return win["path"], None
     if forced == "pallas" or (forced == "auto" and on_tpu):
         with span("kernel/select/pallas"):
             block = kernel_usable_block(k, b, h1_dim, hid, n_pixels,
@@ -592,7 +857,9 @@ def select_path(k: int, b: int, h1_dim: int, hid: int, n_pixels: int, *,
 
 def decoder_score(out_params, x, h1, *, compute_dtype=None,
                   on_tpu: bool = False,
-                  force_path: Optional[str] = None) -> jnp.ndarray:
+                  force_path: Optional[str] = None,
+                  force_tile: Optional[Tuple[int, int]] = None
+                  ) -> jnp.ndarray:
     """``log p(x | h1)`` summed over pixels -> ``[k, B]``, hot-loop-blocked.
 
     `out_params` is the models.mlp output block pytree (``l1``/``l2``/``out``
@@ -602,7 +869,10 @@ def decoder_score(out_params, x, h1, *, compute_dtype=None,
     and blocked-scan paths. Selection happens here, at trace time, and is
     recorded on the telemetry registry. `force_path` pins one implementation
     regardless of env/shape (the program auditor traces every path this way;
-    production callers leave it None).
+    the serving engines pin their probe-gated outcome through the dispatch
+    config); `force_tile` additionally pins the pallas tile (the serving
+    gate / autotuner already validated it — no re-probe inside the trace).
+    Production train/eval callers leave both None.
     """
     w1, b1 = out_params["l1"]["w"], out_params["l1"]["b"]
     w2, b2 = out_params["l2"]["w"], out_params["l2"]["b"]
@@ -612,13 +882,15 @@ def decoder_score(out_params, x, h1, *, compute_dtype=None,
     n_pixels = w3.shape[-1]
     cd = None if compute_dtype is None else jnp.dtype(compute_dtype).name
     path, block = select_path(k, b, h1_dim, hid, n_pixels, on_tpu=on_tpu,
-                              compute_dtype=cd, force=force_path)
+                              compute_dtype=cd, force=force_path,
+                              force_tile=force_tile)
     _record_path(path)
     if path == "pallas":
         return _fused_block_ll(h1, w1, b1, w2, b2, w3, b3, x,
                                block[0], block[1], not on_tpu, cd)
     if path == "blocked_scan":
         return _blocked_scan_impl(h1, w1, b1, w2, b2, w3, b3, x,
-                                  block_k=_scan_block_k(k, b, hid, n_pixels),
+                                  block_k=_scan_block_k(k, b, hid, n_pixels,
+                                                        h1_dim, cd),
                                   compute_dtype=cd)
     return _reference_impl(h1, w1, b1, w2, b2, w3, b3, x, cd)

@@ -87,6 +87,14 @@ def build_argparser() -> argparse.ArgumentParser:
                          "process models one device: disjoint compute, no "
                          "cross-replica contention)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kernel-path", dest="kernel_path", type=str,
+                    default=None,
+                    choices=["reference", "blocked_scan", "pallas"],
+                    help="force the hot-loop path of every engine's score "
+                         "programs (default: the probe-gated per-(op, "
+                         "bucket, k) selection — ops/hot_loop."
+                         "serving_select_path; 'reference' restores the "
+                         "pre-ISSUE-12 serving pin)")
     tier = ap.add_argument_group("serving tier (serving/frontend/)")
     tier.add_argument("--replicas", type=int, default=0,
                       help="run the network tier with N engine replicas "
@@ -202,7 +210,7 @@ def _engine_knobs(args) -> dict:
         max_batch=args.max_batch, max_wait_us=args.max_wait_us,
         queue_limit=args.queue_limit, max_inflight=args.max_inflight,
         timeout_s=(args.timeout_s if args.timeout_s > 0 else None),
-        ladder=ladder, seed=args.seed)
+        ladder=ladder, seed=args.seed, kernel_path=args.kernel_path)
 
 
 def _build_engine(args):

@@ -113,7 +113,9 @@ class ServingEngine:
     window for the two-stage pipeline; ``0`` = serial dispatch, the
     pre-pipeline behavior), ``timeout_s`` (per-request queue deadline; None
     disables), ``ladder`` (shape buckets; default powers-of-two up to
-    max_batch).
+    max_batch), ``kernel_path`` (force the hot-loop implementation of every
+    gated program: None = the probe-gated per-(op, bucket, k) selection,
+    ``"reference"`` = the historical serving pin — see :meth:`_kernel_for`).
     """
 
     def __init__(self, source=None, *, params=None, model_config=None,
@@ -123,7 +125,8 @@ class ServingEngine:
                  queue_limit: int = 1024, max_inflight: int = 2,
                  timeout_s: Optional[float] = 2.0,
                  ladder: Optional[BucketLadder] = None, seed: int = 0,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 kernel_path: Optional[str] = None):
         import jax
 
         if isinstance(source, str):
@@ -140,14 +143,33 @@ class ServingEngine:
         if params is None or model_config is None:
             raise ValueError("pass a model, a checkpoint directory, or "
                              "params= + model_config=")
-        # serving batches are small and vmapped per-row; the Pallas fused
-        # path is shaped for the big train/eval batches and vmapped Mosaic
-        # has not been validated on hardware, so serving programs pin the
-        # unfused composition (== the hot-loop dispatcher's reference path;
-        # the metrics `kernel_path` gauge reports the pin honestly). Lifting
-        # this needs a chip run of the row-vmapped kernel — tracked in
-        # ROADMAP item 4 follow-ups.
-        self.cfg = dataclasses.replace(model_config, fused_likelihood=False)
+        # the serving pin is LIFTED (ROADMAP item 3; PRs 3-11 pinned the
+        # unfused path pending hardware validation of the row-vmapped
+        # kernel): per (op, bucket, k), :meth:`_kernel_for` resolves the
+        # probe-gated hot-loop selection OUTSIDE the trace — one probe
+        # compile of the actual row-vmapped kernel per shape, cached
+        # (ops/hot_loop.serving_select_path), consulting any persisted
+        # autotune winners (ops/autotune.py) — and bakes the outcome into
+        # that dispatch's config (ModelConfig.hot_loop_path/hot_loop_tile).
+        # Any shape the probe rejects — and every ineligible model
+        # (likelihood != "logits") — automatically falls back to `self.cfg`
+        # below: the unfused reference program, byte-identical to the
+        # previously pinned path. `kernel_path` forces one outcome for the
+        # whole engine ("reference" restores the historical pin — the bench
+        # baseline and the parity tests' oracle).
+        self.cfg = dataclasses.replace(model_config, fused_likelihood=False,
+                                       hot_loop_path=None,
+                                       hot_loop_tile=None)
+        if kernel_path is not None and kernel_path not in (
+                "pallas", "blocked_scan", "reference"):
+            raise ValueError(f"kernel_path={kernel_path!r}: expected None "
+                             f"(probe-gated auto) | pallas | blocked_scan "
+                             f"| reference")
+        self.kernel_path_force = kernel_path
+        #: (op, k, bucket) -> (dispatch cfg, path name, tile) — the gate's
+        #: per-shape memo; resolution is deterministic, so the memo only
+        #: saves repeated probe-cache lookups on the dispatch hot path
+        self._kernel_cache: Dict[tuple, tuple] = {}
         self.k = int(k) if k is not None else 50
         # the engine's k admission bound (typed bad_request past it); the
         # default never rejects the engine's own configured k, and an
@@ -400,26 +422,76 @@ class ServingEngine:
                 f"{r.op} request expired after {self.timeout_s}s in queue "
                 f"(engine saturated — shed load or raise timeout_s)"))
 
+    #: ops whose program routes ``log p(x|h)`` through the hot-loop
+    #: dispatcher and are therefore kernel-gated; ``encode``/``decode``
+    #: never touch the decoder score block, so they stay on the reference
+    #: config unconditionally (their programs are byte-identical either way)
+    _GATED_OPS = ("score",)
+
+    def _kernel_for(self, op: str, k: int, bucket: int) -> tuple:
+        """``(dispatch cfg, path name, tile)`` of one (op, k, bucket) —
+        the lifted serving gate. Resolution runs OUTSIDE any trace, is a
+        pure function of (engine config, shape, env, VMEM budget, autotune
+        winners), and is memoized per engine; the chosen path/tile ride the
+        dispatch config, so program identity, the AOT build key, and the
+        metrics stamp all agree by construction."""
+        key = (op, k, bucket)
+        hit = self._kernel_cache.get(key)
+        if hit is None:
+            hit = self._resolve_kernel(op, k, bucket)
+            self._kernel_cache[key] = hit
+        return hit
+
+    def _resolve_kernel(self, op: str, k: int, bucket: int) -> tuple:
+        """One gate resolution (see :meth:`_kernel_for`): the probe-gated
+        row-vmapped selection for gated ops, the reference config — the
+        previously pinned unfused program, bitwise-identical by the PR 6
+        parity pins — for everything else (including every probe
+        rejection: automatic fallback, never a crash)."""
+        from iwae_replication_project_tpu.models.iwae import _on_tpu
+        from iwae_replication_project_tpu.ops.hot_loop import (
+            serving_dispatch_config)
+
+        if op not in self._GATED_OPS:
+            return self.cfg, "reference", None
+        return serving_dispatch_config(self.cfg, k, bucket,
+                                       on_tpu=_on_tpu(),
+                                       force=self.kernel_path_force)
+
+    def _program_for(self, op: str, k: int, bucket: int):
+        """The jitted program of one dispatch (subclasses whose programs
+        close over the config — the mesh-sharded scorer — resolve their
+        per-bucket variant here)."""
+        return self._programs[op][0]
+
+    def _stamp_k(self, op: str, k: int):
+        """The k component of the metrics kernel-stamp key: the PROGRAM
+        identity's k. Static-k engines stamp the request k; the dynamic-k
+        sharded scorer stamps one "dyn" slot per bucket (its selection is
+        k-independent by construction — a ragged k stream must not mint a
+        gauge per k)."""
+        return k
+
     def _dispatch_args(self, op: str, k: int, payload: np.ndarray,
                        seeds: np.ndarray) -> Tuple[tuple, dict, dict]:
         """The (args, kwargs, static_kwargs) of one AOT dispatch — shared by
         the live path and :meth:`warmup` so both hit the same registry key."""
         import jax
 
-        program, takes_k = self._programs[op]
+        _, takes_k = self._programs[op]
         # ONE explicit transfer per dispatch (transfer_guard-clean), not
         # two: device_put dispatch overhead is dispatcher-thread GIL time
         # that competes with the completion stage in the pipelined mode
         payload_dev, seeds_dev = jax.device_put((payload, seeds))
         kwargs = dict(base_key=self._base_key, seeds=seeds_dev)
         kwargs["h_top" if op == "decode" else "x"] = payload_dev
-        static = dict(cfg=self.cfg)
+        static = dict(cfg=self._kernel_for(op, k, len(payload))[0])
         if takes_k:
             static["k"] = k
         return (self._params,), kwargs, static
 
     def _build_key(self, op: str, k: int, bucket: int) -> tuple:
-        return (op, self.cfg, k, bucket)
+        return (op, self._kernel_for(op, k, bucket)[0], k, bucket)
 
     def _aot_name(self, op: str) -> str:
         """Registry/span name of the op's program (subclasses that swap in
@@ -446,8 +518,15 @@ class ServingEngine:
             np.stack([r.payload for r in batch]), bucket)
         seeds = np.zeros((bucket,), np.int32)
         seeds[:n] = [r.seed for r in batch]
-        program, _ = self._programs[op]
+        program = self._program_for(op, k, bucket)
         args, kwargs, static = self._dispatch_args(op, k, payload, seeds)
+        # stamp the gate's selection for THIS dispatch's (op, k, bucket) —
+        # recomputed from the row's own config via the deterministic gate
+        # memo, never read from trace-order state (the PR 6 contract)
+        from iwae_replication_project_tpu.ops.hot_loop import PATH_CODES
+        _, path, tile = self._kernel_for(op, k, bucket)
+        self.metrics.set_kernel(op, self._stamp_k(op, k), bucket,
+                                PATH_CODES[path], path, tile)
         s0 = cache_stats()
         # spans nest: serve/dispatch/aot/serve_<op> — the outer one (in the
         # engine's own registry) covers pad+device_put+enqueue, NOT device
@@ -543,11 +622,12 @@ class ServingEngine:
         s0 = cache_stats()
         t0 = time.perf_counter()
         n_programs = 0
+        from iwae_replication_project_tpu.ops.hot_loop import PATH_CODES
         with span("serve/warmup", registry=self.metrics.registry):
             for op in ops:
                 if op not in self._programs:
                     raise ValueError(f"unknown op {op!r}")
-                program, takes_k = self._programs[op]
+                _, takes_k = self._programs[op]
                 for k in (ks if takes_k else [0]):
                     for bucket in self.ladder.buckets:
                         payload = np.zeros((bucket, self.row_dims[op]),
@@ -555,20 +635,26 @@ class ServingEngine:
                         seeds = np.zeros((bucket,), np.int32)
                         args, kwargs, static = self._dispatch_args(
                             op, k, payload, seeds)
-                        aot_warm(self._aot_name(op), program, args,
+                        aot_warm(self._aot_name(op),
+                                 self._program_for(op, k, bucket), args,
                                  kwargs=kwargs, static_kwargs=static,
                                  build_key=self._build_key(op, k, bucket))
+                        _, path, tile = self._kernel_for(op, k, bucket)
+                        self.metrics.set_kernel(op, self._stamp_k(op, k),
+                                                bucket, PATH_CODES[path],
+                                                path, tile)
                         n_programs += 1
         d = stats_delta(s0)
-        # record which hot-loop path this engine's programs run on THIS
-        # engine's registry (ops/hot_loop.PATH_CODES) — recomputed from the
-        # engine's own config at the per-row program shape, never read from
-        # trace-order state (a cache-warm warmup traces nothing)
-        from iwae_replication_project_tpu.ops.hot_loop import (
-            path_code_for_model)
-        from iwae_replication_project_tpu.models.iwae import _on_tpu
+        # record which hot-loop path this engine's score programs run on
+        # THIS engine's registry (ops/hot_loop.PATH_CODES) — recomputed
+        # through the deterministic gate memo for the engine's own
+        # (config, k, bucket), never read from trace-order state (a
+        # cache-warm warmup traces nothing). With the pin lifted this is
+        # the lifted gate's outcome, not a hard-coded reference stamp.
+        _, path, _ = self._kernel_for("score", self.k,
+                                      self.ladder.bucket_for(1))
         self.metrics.registry.gauge("kernel_path").set(
-            path_code_for_model(self.cfg, self.k, 1, on_tpu=_on_tpu()))
+            float(PATH_CODES[path]))
         return {"programs": float(n_programs),
                 "compiles": float(d["aot_misses"]),
                 "recompiles": float(d["persistent_cache_misses"]),

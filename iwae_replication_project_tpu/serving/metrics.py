@@ -20,7 +20,8 @@ Export surfaces, unchanged schema:
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+import threading
+from typing import Dict, Optional, Tuple
 
 from iwae_replication_project_tpu.telemetry.registry import (
     Histogram,
@@ -64,6 +65,12 @@ class ServingMetrics:
             self.registry.counter(name)
         self._queue_depth = self.registry.gauge("queue_depth")
         self._inflight = self.registry.gauge("inflight")
+        # per-(op, bucket, k) hot-loop selection stamps (engine._kernel_for
+        # outcomes): the path code rides a registry gauge (Prometheus page),
+        # the tile — a non-scalar — rides this dict for snapshot()/flat().
+        # Written by the dispatcher thread, read by scrapes -> own lock.
+        self._kernel_lock = threading.Lock()
+        self._kernel: Dict[str, dict] = {}
 
     def count(self, name: str, n: float = 1) -> None:
         self.registry.counter(name).inc(n)
@@ -83,6 +90,21 @@ class ServingMetrics:
     @property
     def inflight(self) -> int:
         return int(self._inflight.value)
+
+    def set_kernel(self, op: str, k: int, bucket: int, path_code: int,
+                   path: str, tile: Optional[Tuple[int, int]]) -> None:
+        """Stamp the hot-loop selection of one (op, k, bucket) dispatch
+        config — recomputed per row config by the engine's gate (PR 6
+        contract: never trace-order state). The code lands on a
+        ``kernel/<op>/b<bucket>/k<k>`` gauge (scraped on the Prometheus
+        page like any scalar); the tile joins it in snapshot()/flat()."""
+        key = f"{op}/b{bucket}/k{k}"
+        self.registry.gauge(f"kernel/{key}").set(float(path_code))
+        with self._kernel_lock:
+            self._kernel[key] = {
+                "path_code": int(path_code), "path": str(path),
+                "tile": list(tile) if tile is not None else None,
+            }
 
     def record_latency(self, op: str, bucket: int, seconds: float) -> None:
         self.registry.histogram(f"{_LAT}{op}/b{bucket}",
@@ -115,13 +137,20 @@ class ServingMetrics:
                     for name, s in snap["histograms"].items()
                     if name.startswith(prefix)}
 
+        with self._kernel_lock:
+            kernel = {key: dict(rec) for key, rec in self._kernel.items()}
         return {
             "counters": c,
             "queue_depth": int(snap["gauges"].get("queue_depth", 0)),
             "inflight": int(snap["gauges"].get("inflight", 0)),
-            # which hot-loop path the engine's programs traced with
-            # (ops/hot_loop.PATH_CODES; set by ServingEngine.warmup)
+            # which hot-loop path the engine's score programs run
+            # (ops/hot_loop.PATH_CODES; set by ServingEngine.warmup from
+            # the lifted gate at the engine's own (config, k, bucket))
             "kernel_path": int(snap["gauges"].get("kernel_path", 0)),
+            # per-(op, bucket, k) gate outcomes: the selected path (code +
+            # name) and — when fused on the pallas path — the (tk, tb)
+            # tile, stamped per dispatch config by the engine's gate
+            "kernel": kernel,
             "padding_waste": (c["padded_rows"] / rows) if rows else 0.0,
             "latency": section(_LAT),
             "queue_wait": section(_QW),
@@ -139,6 +168,8 @@ class ServingMetrics:
         out["inflight"] = float(snap["inflight"])
         out["kernel_path"] = float(snap["kernel_path"])
         out["padding_waste"] = float(snap["padding_waste"])
+        for key, rec in snap["kernel"].items():
+            out[f"kernel/{key}/path_code"] = float(rec["path_code"])
         for kind in ("latency", "queue_wait", "device_wait"):
             for name, s in snap[kind].items():
                 for q in ("p50_s", "p95_s", "p99_s", "mean_s"):

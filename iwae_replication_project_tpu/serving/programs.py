@@ -144,6 +144,11 @@ PADDED_ROW_KWARGS = {
     "score": ("seeds", "x"),
     "encode": ("seeds", "x"),
     "decode": ("seeds", "h_top"),
+    # the same score program under the lifted gate's fused dispatch config
+    # (ModelConfig.hot_loop_path pin — serving/engine._kernel_for): the
+    # padded-row contract is identical, the audited dataflow routes the
+    # per-row decoder block through the hot-loop dispatcher
+    "score_fused": ("seeds", "x"),
     # the mesh-sharded large-k score program (make_sharded_score_rows):
     # same per-row payload contract, dispatched by ShardedScoreEngine
     "score_sharded": ("seeds", "x"),

@@ -109,6 +109,7 @@ class ShardedScoreEngine(ServingEngine):
         self.k = self.menu.validate_k(self.k)
         self.k_max = int(k_max)
         self.mesh = mesh
+        self._dp = dp
         self.sharded = True
         # one program, one op: this replica IS the large-k scoring service
         self._programs = {
@@ -125,7 +126,44 @@ class ShardedScoreEngine(ServingEngine):
         self._row_spec = NamedSharding(mesh, P(AXES.dp))
         self._scalar_spec = NamedSharding(mesh, P())
 
-    # -- dispatch plumbing (the two hooks the base engine dispatches via) --
+    # -- dispatch plumbing (the hooks the base engine dispatches via) ------
+
+    def _resolve_kernel(self, op: str, k: int, bucket: int) -> tuple:
+        """The sharded scorer's kernel gate: the hot loop runs inside the
+        per-row streaming body at ``k_chunk``-sample blocks over the
+        bucket's dp-local rows, so THAT — (k_chunk, bucket/dp) — is the
+        shape the probe must vouch for, independent of the request's
+        dynamic k (one outcome per bucket keeps the zero-recompile
+        contract: build keys never vary with k)."""
+        from iwae_replication_project_tpu.models.iwae import _on_tpu
+        from iwae_replication_project_tpu.ops.hot_loop import (
+            serving_dispatch_config)
+
+        if op not in self._GATED_OPS:
+            return self.cfg, "reference", None
+        rows = max(bucket // self._dp, 1)
+        return serving_dispatch_config(self.cfg, self.menu.k_chunk, rows,
+                                       on_tpu=_on_tpu(),
+                                       force=self.kernel_path_force)
+
+    def _program_for(self, op: str, k: int, bucket: int):
+        """Per-bucket program: the sharded score program closes over its
+        config, so a bucket whose gate resolves a fused path gets its own
+        (lru-cached) jitted program; reference buckets share the pinned
+        one built at construction."""
+        from iwae_replication_project_tpu.serving.programs import (
+            make_sharded_score_rows)
+
+        cfg_d, _, _ = self._kernel_for(op, k, bucket)
+        if cfg_d is self.cfg:
+            return self._programs[op][0]
+        return make_sharded_score_rows(cfg_d, self.mesh, self.menu.k_chunk)
+
+    def _stamp_k(self, op: str, k: int):
+        # one dynamic-k program per bucket serves every k: the kernel
+        # stamp is per bucket, not per request k (a ragged k stream must
+        # not mint a metrics gauge per distinct k)
+        return "dyn"
 
     def _dispatch_args(self, op: str, k: int, payload: np.ndarray,
                        seeds: np.ndarray) -> Tuple[tuple, dict, dict]:
@@ -145,9 +183,11 @@ class ShardedScoreEngine(ServingEngine):
             mesh_fingerprint)
 
         # k deliberately absent: the dynamic-k program's identity is
-        # (config, chunk, mesh, bucket) — the zero-recompile contract
-        return ("score_sharded", self.cfg, self.menu.k_chunk,
-                mesh_fingerprint(self.mesh), bucket)
+        # (config, chunk, mesh, bucket) — the zero-recompile contract. The
+        # config is the GATE's dispatch config (carries the hot-loop pin),
+        # whose resolution is bucket-only, never k (see _resolve_kernel).
+        return ("score_sharded", self._kernel_for(op, k, bucket)[0],
+                self.menu.k_chunk, mesh_fingerprint(self.mesh), bucket)
 
     def _aot_name(self, op: str) -> str:
         return "serve_score_sharded"

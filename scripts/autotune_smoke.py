@@ -1,0 +1,139 @@
+"""Autotune + lifted-serving-gate smoke stage for scripts/check.py.
+
+Exercises, in one short CPU process (``JAX_PLATFORMS=cpu``):
+
+1. a REAL (tiny-shape) autotune search: measured candidates, a persisted
+   winner, and the once-per-fleet warm-cache contract — the second tuning
+   run over the same key must be a pure lookup (zero searches, zero probe
+   compiles, the injected-measure hook never needed);
+2. the winner cache round-trip: a fresh in-memory store re-reads the same
+   winner from disk, a corrupt file falls back LOUDLY to the hand-picked
+   tiles, and a version bump invalidates silently;
+3. fused-vs-reference serving parity through REAL engines: the probe-gated
+   auto engine and the forced blocked-scan (fused) engine must return
+   request-by-request bitwise-identical results to the historically pinned
+   reference engine, with the per-(op, bucket, k) kernel stamps telling
+   them apart, and a persisted serving winner steering the gate.
+
+Exit 0 on success, 1 with a message on the first failed check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        setup_persistent_cache)
+
+    # warm-path discipline, like every entry point: repeated CI runs of the
+    # parity engines below should hit the persistent cache
+    setup_persistent_cache(base_dir=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+    import numpy as np
+
+    from iwae_replication_project_tpu.models import ModelConfig
+    from iwae_replication_project_tpu.ops import autotune as at
+    from iwae_replication_project_tpu.ops import hot_loop as hl
+    from iwae_replication_project_tpu.serving import ServingEngine
+    from iwae_replication_project_tpu.telemetry.registry import get_registry
+    from iwae_replication_project_tpu.training import create_train_state
+
+    tmp = tempfile.mkdtemp(prefix="iwae_autotune_smoke_")
+    cache = os.path.join(tmp, "autotune_cache.json")
+
+    def counter(name):
+        return get_registry().counter(f"autotune/{name}").value
+
+    # 1) tiny REAL search + the warm-cache contract
+    shape = (4, 8, 10, 16, 20)      # (k, rows, h1_dim, hid, n_pixels)
+    rec = at.tune("serving_row", *shape, path=cache, reps=1)
+    assert rec["cache"] == "tuned", rec
+    assert rec["measured_candidates"] >= 2, rec
+    at.reload_store()
+    probes0, searches0 = counter("probe_compiles"), counter("searches")
+    rec2 = at.tune("serving_row", *shape, path=cache, reps=1)
+    assert rec2["cache"] == "hit" and rec2["path"] == rec["path"], rec2
+    assert counter("probe_compiles") == probes0, "warm tune probed"
+    assert counter("searches") == searches0, "warm tune searched"
+
+    # 2) cache round-trip + corrupt fallback + version invalidation
+    at.reload_store()
+    assert at.winner_for("serving_row", *shape, None,
+                         path=cache) is not None, "winner lost on reload"
+    doc = json.load(open(cache))
+    doc["version"] = at.AUTOTUNE_VERSION + 1
+    json.dump(doc, open(cache, "w"))
+    at.reload_store()
+    assert at.winner_for("serving_row", *shape, None, path=cache) is None, \
+        "version bump did not invalidate"
+    with open(cache, "w") as f:
+        f.write("{corrupt")
+    at.reload_store()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert at.winner_for("serving_row", *shape, None,
+                             path=cache) is None
+    assert any("corrupt" in str(w.message) for w in caught), \
+        "corrupt cache did not warn"
+
+    # 3) fused-vs-reference parity through real engines + gate steering
+    cfg = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                      n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12,
+                      likelihood="logits")
+    params = create_train_state(jax.random.PRNGKey(0), cfg).params
+    x = (np.random.RandomState(7).rand(9, 12) > 0.5).astype(np.float32)
+
+    def serve_all(eng):
+        return np.concatenate([eng.score(x[:n]) for n in (1, 3, 7, 2)])
+
+    mk = lambda force: ServingEngine(params=params, model_config=cfg, k=4,
+                                     max_batch=8, timeout_s=None,
+                                     kernel_path=force)
+    ref = serve_all(mk("reference"))
+    auto_eng, scan_eng = mk(None), mk("blocked_scan")
+    assert np.array_equal(serve_all(auto_eng), ref), \
+        "probe-gated auto engine diverged from the pinned path"
+    assert np.array_equal(serve_all(scan_eng), ref), \
+        "fused (blocked_scan) engine diverged from the pinned path"
+    stamps = scan_eng.metrics.snapshot()["kernel"]
+    assert stamps["score/b4/k4"]["path"] == "blocked_scan", stamps
+    assert auto_eng.metrics.snapshot()["kernel"]["score/b4/k4"][
+        "path"] == "reference"
+
+    # a persisted serving winner steers a fresh engine's gate — still
+    # bitwise identical (the blocked-scan forward is bitwise-equal)
+    key = at.entry_key("serving_row", 4, 4, 4, 16, 12, None)
+    at._save_store(cache, {key: {"path": "blocked_scan", "block_k": 2}})
+    os.environ["IWAE_AUTOTUNE_CACHE"] = cache
+    at.reload_store()
+    try:
+        steered = ServingEngine(params=params, model_config=cfg, k=4,
+                                max_batch=8, timeout_s=None)
+        got = serve_all(steered)
+        assert np.array_equal(got, ref), "winner-steered engine diverged"
+        assert steered.metrics.snapshot()["kernel"]["score/b4/k4"][
+            "path"] == "blocked_scan", "persisted winner did not steer"
+    finally:
+        os.environ.pop("IWAE_AUTOTUNE_CACHE", None)
+        at.reload_store()
+
+    print("autotune smoke: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"autotune smoke FAILED: {e}")
+        sys.exit(1)

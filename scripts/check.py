@@ -26,13 +26,17 @@ machine-readable summary.
    ``parallel/eval`` scorer and zero recompiles over a ragged (batch, k)
    stream;
 8. **hot-loop smoke** (scripts/hot_loop_smoke.py);
-9. **chaos smoke** (scripts/chaos_smoke.py) — the failure model under a
+9. **autotune smoke** (scripts/autotune_smoke.py) — a real tiny tile/remat
+   search with the warm-cache (zero probe compiles) contract, winner-cache
+   round-trip/corruption fallback, and fused-vs-reference serving parity
+   through the lifted engine gate;
+10. **chaos smoke** (scripts/chaos_smoke.py) — the failure model under a
    seeded fault schedule: replica crash + AOT fault + dropped connection
    vs a retrying client (bitwise parity, zero lost futures), a slow
    replica beaten by a client hedge, SIGTERM-mid-stage + resume and
    truncated-checkpoint fallback both bitwise-identical to an
    uninterrupted run; summary committed to ``results/chaos_smoke.json``;
-10. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
+11. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
    ``--sanitize`` armed.
 
 Every full-gate run writes ``results/check_summary.json`` (per-stage status,
@@ -184,6 +188,12 @@ def run_hot_loop_smoke() -> dict:
                                                   "hot_loop_smoke.py")])
 
 
+def run_autotune_smoke() -> dict:
+    return run_step("autotune smoke",
+                    [sys.executable, os.path.join("scripts",
+                                                  "autotune_smoke.py")])
+
+
 def run_chaos_smoke() -> dict:
     return run_step("chaos smoke",
                     [sys.executable, os.path.join("scripts",
@@ -233,6 +243,7 @@ def main(argv=None) -> int:
         stages.append(run_serving_tier_smoke())
         stages.append(run_large_k_smoke())
         stages.append(run_hot_loop_smoke())
+        stages.append(run_autotune_smoke())
         stages.append(run_chaos_smoke())
     if not args.lint_only:
         stages.append(run_tests(passthrough))

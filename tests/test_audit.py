@@ -549,7 +549,7 @@ class TestRealProgramSuite:
     def test_serving_programs_declare_their_padding(self):
         by_name = {p.name: p for p in build_programs(
             ["serve_score", "serve_encode", "serve_decode",
-             "serve_score_sharded"])}
+             "serve_score_fused", "serve_score_sharded"])}
         for p in by_name.values():
             assert len(p.taints) == 2, \
                 f"{p.name} lost its padded-row taint declaration"
@@ -606,8 +606,9 @@ class TestCli:
         assert payload["total"] == 0
         assert set(payload["programs"]) == {
             "train_step", "eval_scorer_k5000", "serve_score", "serve_encode",
-            "serve_decode", "serve_score_sharded", "hot_loop_reference",
-            "hot_loop_blocked_scan", "hot_loop_pallas"}
+            "serve_decode", "serve_score_fused", "serve_score_sharded",
+            "hot_loop_reference", "hot_loop_blocked_scan",
+            "hot_loop_pallas"}
 
 
 # ---------------------------------------------------------------------------

@@ -378,11 +378,11 @@ class TestRoofline:
 
 class TestRealSuite:
     def test_full_suite_analyzes_clean(self):
-        """THE acceptance gate: cost records for all 9 programs, zero
+        """THE acceptance gate: cost records for all 10 programs, zero
         findings (scripts/check.py's cost stage contract)."""
         records, findings = analyze_programs()
         assert findings == [], "\n".join(f.human() for f in findings)
-        assert len(records) == 9
+        assert len(records) == 10
         for rec in records.values():
             assert rec.peak_bytes > 0 and rec.flops > 0
 
@@ -475,8 +475,9 @@ class TestCli:
             report = json.load(f)
         assert set(report["programs"]) == {
             "train_step", "eval_scorer_k5000", "serve_score", "serve_encode",
-            "serve_decode", "serve_score_sharded", "hot_loop_reference",
-            "hot_loop_blocked_scan", "hot_loop_pallas"}
+            "serve_decode", "serve_score_fused", "serve_score_sharded",
+            "hot_loop_reference", "hot_loop_blocked_scan",
+            "hot_loop_pallas"}
         assert report["total"] == 0
         sharded = report["programs"]["serve_score_sharded"]
         assert sharded["collectives"] == {

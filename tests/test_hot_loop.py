@@ -447,3 +447,119 @@ class TestModelIntegration:
         assert peak_flops_for_kind("TPU v6e")[0] == 918e12
         peak, source = peak_flops_for_kind("warp drive 9000")
         assert peak is None and "warp drive 9000" in source
+
+
+class TestServingGate:
+    """serving_select_path / serving_kernel_usable — the lifted pin's
+    trace-outside resolution (ISSUE 12)."""
+
+    def test_auto_off_tpu_is_reference(self):
+        assert hl.serving_select_path(4, 8, 10, 16, 20,
+                                      on_tpu=False) == ("reference", None)
+
+    def test_force_paths(self):
+        assert hl.serving_select_path(4, 8, 10, 16, 20, on_tpu=False,
+                                      force="blocked_scan") == \
+            ("blocked_scan", None)
+        assert hl.serving_select_path(4, 8, 10, 16, 20, on_tpu=False,
+                                      force="reference") == \
+            ("reference", None)
+        # forced pallas off-TPU: interpret mode, the estimate admits the
+        # per-row (tk, 1) tile
+        path, tile = hl.serving_select_path(4, 8, 10, 16, 20, on_tpu=False,
+                                            force="pallas")
+        assert path == "pallas" and tile == (4, 1)
+
+    def test_force_validation(self):
+        with pytest.raises(ValueError, match="force argument"):
+            hl.serving_select_path(4, 8, 10, 16, 20, on_tpu=False,
+                                   force="mosaic")
+
+    def test_env_override(self, monkeypatch):
+        monkeypatch.setenv("IWAE_HOT_LOOP_PATH", "blocked_scan")
+        assert hl.serving_select_path(4, 8, 10, 16, 20,
+                                      on_tpu=False)[0] == "blocked_scan"
+
+    def test_scan_threshold_applies_to_bucket_workset(self, monkeypatch):
+        # the whole-bucket working set k * rows * (2*hid + pix) decides
+        # the scan threshold, mirroring select_path's auto rule
+        monkeypatch.setenv("IWAE_HOT_LOOP_SCAN_BYTES", "1000")
+        assert hl.serving_select_path(64, 64, 10, 16, 20,
+                                      on_tpu=False)[0] == "blocked_scan"
+
+    def test_oversized_row_tile_rejected(self, monkeypatch):
+        # a per-row tile that cannot fit the budget -> None -> fallback
+        monkeypatch.setenv("IWAE_FUSED_VMEM_BUDGET", "1")
+        assert hl.serving_kernel_usable(8, 4, 10, 16, 20,
+                                        interpret=True) is None
+        with pytest.warns(RuntimeWarning, match="no tile fits"):
+            path, _ = hl.serving_select_path(8, 4, 10, 16, 20,
+                                             on_tpu=False, force="pallas")
+        assert path == "blocked_scan"  # forced-pallas fallback, loudly
+
+    def test_tile_proposal_validated(self):
+        # an admissible proposed tk is honored; garbage falls back to the
+        # default K-slab
+        assert hl.serving_kernel_usable(16, 4, 10, 16, 20, interpret=True,
+                                        tile=(16, 1)) == (16, 1)
+        assert hl.serving_kernel_usable(16, 4, 10, 16, 20, interpret=True,
+                                        tile=(13, 7)) == (8, 1)
+
+
+class TestForcedTileAndConfigPins:
+    def test_select_path_force_tile(self):
+        path, tile = hl.select_path(16, 130, 10, 16, 20, on_tpu=False,
+                                    force="pallas", force_tile=(16, 128))
+        assert (path, tile) == ("pallas", (16, 128))
+        with pytest.raises(ValueError, match="not admissible"):
+            hl.select_path(16, 130, 10, 16, 20, on_tpu=False,
+                           force="pallas", force_tile=(13, 40))
+
+    def test_model_config_pins_flow_to_dispatch(self, rng):
+        cfg = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                          n_hidden_dec=(16,), n_latent_dec=(12,), x_dim=12,
+                          likelihood="logits")
+        cfg_pin = ModelConfig(n_hidden_enc=(16,), n_latent_enc=(4,),
+                              n_hidden_dec=(16,), n_latent_dec=(12,),
+                              x_dim=12, likelihood="logits",
+                              fused_likelihood=True,
+                              hot_loop_path="blocked_scan")
+        assert hl.path_code_for_model(cfg_pin, 4, 6, on_tpu=False) == float(
+            hl.PATH_CODES["blocked_scan"])
+        params = init_params(rng, cfg)
+        x = (jax.random.uniform(jax.random.PRNGKey(1), (6, 12)) > 0.5
+             ).astype(jnp.float32)
+        key = jax.random.PRNGKey(2)
+        from iwae_replication_project_tpu.models import log_weights
+        want = log_weights(params, cfg, key, x, k=4)
+        got = log_weights(params, cfg_pin, key, x, k=4)  # iwaelint: disable=key-reuse -- parity check deliberately replays the IDENTICAL key; only the dispatch pin differs
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+    def test_model_config_pin_validation(self):
+        kw = dict(n_hidden_enc=(16,), n_latent_enc=(4,), n_hidden_dec=(16,),
+                  n_latent_dec=(12,), x_dim=12, likelihood="logits")
+        with pytest.raises(ValueError, match="requires"):
+            ModelConfig(hot_loop_path="pallas", **kw)
+        with pytest.raises(ValueError, match="unknown hot_loop_path"):
+            ModelConfig(fused_likelihood=True, hot_loop_path="mosaic", **kw)
+        with pytest.raises(ValueError, match="hot_loop_tile requires"):
+            ModelConfig(fused_likelihood=True,
+                        hot_loop_path="blocked_scan",
+                        hot_loop_tile=(8, 1), **kw)
+        with pytest.raises(ValueError, match="two positive ints"):
+            ModelConfig(fused_likelihood=True, hot_loop_path="pallas",
+                        hot_loop_tile=(8, 0), **kw)
+        # tiles normalize to hashable int tuples (jit-static requirement)
+        cfg = ModelConfig(fused_likelihood=True, hot_loop_path="pallas",
+                          hot_loop_tile=[8, 1], **kw)
+        assert cfg.hot_loop_tile == (8, 1)
+        hash(cfg)
+
+    def test_tile_admissible(self):
+        assert hl.tile_admissible(8, 128, 50, 300)
+        assert hl.tile_admissible(8, 300, 50, 300)     # full batch
+        assert hl.tile_admissible(4, 1, 4, 1)          # tk == k < 8
+        assert not hl.tile_admissible(13, 128, 50, 300)
+        assert not hl.tile_admissible(8, 40, 50, 300)  # partial non-128
+        assert not hl.tile_admissible(0, 128, 50, 300)
+        assert not hl.tile_admissible(16, 128, 4, 300)  # tk > max(k, 8)

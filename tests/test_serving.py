@@ -665,3 +665,140 @@ def test_cli_synthetic_load_sweep(tmp_path):
     assert jsonl.exists()
     row = json.loads(jsonl.read_text().splitlines()[-1])
     assert row["completed"] == c["completed"]
+
+
+# ---------------------------------------------------------------------------
+# the lifted kernel gate (ISSUE 12): probe-gated fused serving + stamps
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_logits():
+    """A fuse-ELIGIBLE tiny model (the gate requires likelihood='logits';
+    the module `tiny` fixture's clamp likelihood pins it to reference)."""
+    cfg = model.ModelConfig(x_dim=D, likelihood="logits", **TINY)
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+    x = (np.random.RandomState(3).rand(17, D) > 0.5).astype(np.float32)
+    return {"cfg": cfg, "params": params, "x": x}
+
+
+def make_logits_engine(tiny_logits, **kw):
+    kw.setdefault("k", 4)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("timeout_s", None)
+    return ServingEngine(params=tiny_logits["params"],
+                         model_config=tiny_logits["cfg"], **kw)
+
+
+def test_kernel_path_force_validation(tiny_logits):
+    with pytest.raises(ValueError, match="kernel_path"):
+        make_logits_engine(tiny_logits, kernel_path="mosaic")
+
+
+def test_unpinned_engine_bitwise_matches_pinned(tiny_logits):
+    """THE lift acceptance pin: the unpinned engine (probe-gated auto) and
+    every forced fused path return bitwise-identical results to the
+    historical pin (kernel_path='reference') on the same ragged stream.
+    On this CPU host auto resolves reference (no TPU -> no pallas, tiny
+    working set -> no scan), so the auto leg also proves the fallback IS
+    the pinned program; the blocked_scan leg proves the FUSED serving
+    program against it (the scan forward is bitwise-equal by design)."""
+    x = tiny_logits["x"]
+    engines = {
+        "reference": make_logits_engine(tiny_logits,
+                                        kernel_path="reference"),
+        "auto": make_logits_engine(tiny_logits),
+        "blocked_scan": make_logits_engine(tiny_logits,
+                                           kernel_path="blocked_scan"),
+    }
+    outs = {}
+    for name, eng in engines.items():
+        got = [eng.score(x[:n]) for n in (1, 3, 7, 2)]
+        outs[name] = np.concatenate(got)
+    assert np.array_equal(outs["reference"], outs["auto"])
+    assert np.array_equal(outs["reference"], outs["blocked_scan"])
+    # the stamps tell the three apart (the observable the fleet scrapes)
+    assert engines["auto"].metrics.snapshot()["kernel"]["score/b4/k4"][
+        "path"] == "reference"
+    assert engines["blocked_scan"].metrics.snapshot()["kernel"][
+        "score/b4/k4"]["path"] == "blocked_scan"
+
+
+def test_unpinned_fused_warm_ragged_zero_compiles(tiny_logits):
+    """The fused serving engine keeps the warm-path contract: warmup every
+    rung, then a ragged stream compiles NOTHING (the gate resolution is
+    memoized outside the trace, so probe work cannot leak into dispatch)."""
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        cache_stats, stats_delta)
+
+    eng = make_logits_engine(tiny_logits, kernel_path="blocked_scan")
+    eng.warmup(ops=("score",))
+    s0 = cache_stats()
+    for n in (1, 3, 7, 2, 8, 5, 1, 4):
+        eng.score(tiny_logits["x"][:n])
+    d = stats_delta(s0)
+    assert d["aot_misses"] == 0, "fused ragged stream compiled after warmup"
+    c = eng.metrics.snapshot()["counters"]
+    assert c["aot_misses"] == 0 and c["recompiles"] == 0
+
+
+def test_clamp_model_is_gate_ineligible(tiny):
+    """A likelihood='clamp' model cannot fuse (the kernel computes the
+    exact logits-form Bernoulli): the gate must resolve reference even
+    when the engine asks for a fused path by force."""
+    eng = make_engine(tiny, timeout_s=None, kernel_path="blocked_scan")
+    cfg_d, path, tile = eng._kernel_for("score", 4, 4)
+    assert path == "reference" and tile is None and cfg_d is eng.cfg
+    out = eng.score(tiny["x"][:3])
+    assert out.shape == (3,) and np.isfinite(out).all()
+
+
+def test_encode_decode_stay_on_reference(tiny_logits):
+    """Only score routes through the decoder block: encode/decode resolve
+    reference regardless of forcing (their programs never touch it)."""
+    eng = make_logits_engine(tiny_logits, kernel_path="blocked_scan")
+    assert eng._kernel_for("encode", 4, 4)[1] == "reference"
+    assert eng._kernel_for("decode", 0, 4)[1] == "reference"
+    assert eng._kernel_for("score", 4, 4)[1] == "blocked_scan"
+
+
+def test_kernel_stamp_schema(tiny_logits):
+    """The ISSUE 12 metrics satellite: kernel_path (and tile when fused)
+    in snapshot/flat and on the Prometheus page, per (op, bucket, k)."""
+    from iwae_replication_project_tpu.ops import hot_loop as hl
+    from iwae_replication_project_tpu.telemetry.exporters import (
+        prometheus_text)
+
+    eng = make_logits_engine(tiny_logits, kernel_path="blocked_scan")
+    eng.score(tiny_logits["x"][:3])          # bucket 4
+    eng.encode(tiny_logits["x"][:1])         # bucket 1, reference
+    snap = eng.metrics.snapshot()
+    rec = snap["kernel"]["score/b4/k4"]
+    assert rec == {"path_code": hl.PATH_CODES["blocked_scan"],
+                   "path": "blocked_scan", "tile": None}
+    assert snap["kernel"]["encode/b1/k4"]["path"] == "reference"
+    flat = eng.metrics.flat()
+    assert flat["kernel/score/b4/k4/path_code"] == float(
+        hl.PATH_CODES["blocked_scan"])
+    assert all(isinstance(v, float) for v in flat.values())
+    page = prometheus_text([eng.metrics.registry])
+    assert "kernel_score_b4_k4" in page
+    # a forced-pallas engine stamps its tile (interpret mode on CPU: the
+    # estimate admits the (tk, 1) row tile without a probe)
+    eng_p = make_logits_engine(tiny_logits, kernel_path="pallas")
+    cfg_d, path, tile = eng_p._kernel_for("score", 4, 4)
+    assert path == "pallas" and tile == (4, 1)
+    assert cfg_d.hot_loop_tile == (4, 1)
+
+
+def test_forced_pallas_serving_matches_reference(tiny_logits):
+    """The row-vmapped kernel itself (interpret mode off-TPU) through the
+    REAL engine dispatch: numerically equal to the pinned path (the kernel
+    reorders the pixel reduction, so this pin is allclose; the bitwise
+    pins ride the reference/blocked_scan paths)."""
+    x = tiny_logits["x"][:5]
+    pinned = make_logits_engine(tiny_logits, kernel_path="reference")
+    fused = make_logits_engine(tiny_logits, kernel_path="pallas")
+    a, b = pinned.score(x), fused.score(x)
+    assert np.allclose(a, b, rtol=1e-5, atol=1e-4)
+    assert fused.metrics.snapshot()["kernel"]["score/b8/k4"][
+        "path"] == "pallas"

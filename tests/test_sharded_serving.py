@@ -646,3 +646,96 @@ def test_tier_routes_mixed_traffic_to_the_right_class():
         tier.stop()
     assert [(op, k) for op, k, _ in big.served] == [("score", 500)]
     assert sorted(op for op, _, _ in fast.served) == ["encode", "score"]
+
+
+# ---------------------------------------------------------------------------
+# the lifted kernel gate on the sharded scorer (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+CFG_LOGITS = model.ModelConfig(n_hidden_enc=(16, 8), n_latent_enc=(6, 3),
+                               n_hidden_dec=(8, 16), n_latent_dec=(6, 12),
+                               x_dim=D, likelihood="logits")
+
+
+@pytest.fixture(scope="module")
+def tiny_logits():
+    params = model.init_params(jax.random.PRNGKey(0), CFG_LOGITS)
+    x = (np.random.RandomState(2).rand(9, D) > 0.5).astype(np.float32)
+    return {"params": params, "x": x}
+
+
+def make_sharded_logits(tiny_logits, mesh, **kw):
+    kw.setdefault("k_chunk", CHUNK)
+    kw.setdefault("k_max", 100)
+    kw.setdefault("k", 8)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("timeout_s", None)
+    return ShardedScoreEngine(params=tiny_logits["params"],
+                              model_config=CFG_LOGITS, mesh=mesh, **kw)
+
+
+def test_sharded_unpinned_bitwise_matches_pinned(tiny_logits):
+    """ISSUE 12 acceptance for the sharded scorer: the probe-gated engine
+    and the forced fused (blocked_scan) engine are request-by-request
+    bitwise identical to the historical pin over a ragged (batch, k)
+    stream. The gate runs at the k_chunk block shape — the dynamic k never
+    enters resolution, so one executable per bucket still serves every k."""
+    mesh = make_mesh(dp=1, sp=1, devices=jax.devices()[:1])
+    x = tiny_logits["x"]
+    outs = {}
+    engines = {}
+    for name in ("reference", "auto", "blocked_scan"):
+        eng = make_sharded_logits(
+            tiny_logits, mesh,
+            kernel_path=None if name == "auto" else name)
+        engines[name] = eng
+        fs = [eng.submit("score", r, k=kk)
+              for kk in (3, 8, 17) for r in x[:4]]
+        eng.flush()
+        outs[name] = np.asarray([f.result() for f in fs])
+    assert np.array_equal(outs["reference"], outs["auto"])
+    assert np.array_equal(outs["reference"], outs["blocked_scan"])
+    # the dynamic-k program stamps ONE slot per bucket (kdyn), not per k
+    snap = engines["blocked_scan"].metrics.snapshot()["kernel"]
+    assert snap["score/b4/kdyn"]["path"] == "blocked_scan"
+    assert not any("/k3" in key or "/k17" in key for key in snap)
+
+
+def test_sharded_fused_zero_recompiles_ragged_k(tiny_logits):
+    """The zero-recompile contract survives the lift: the FUSED sharded
+    engine warms one executable per bucket and a ragged (batch, k) stream
+    compiles nothing (gate resolution is bucket-only by construction)."""
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        cache_stats, stats_delta)
+
+    mesh = make_mesh(dp=1, sp=1, devices=jax.devices()[:1])
+    eng = make_sharded_logits(tiny_logits, mesh,
+                              kernel_path="blocked_scan")
+    eng.warmup()
+    s0 = cache_stats()
+    fs = [eng.submit("score", r, k=kk)
+          for kk in (1, 5, 9, 33, 100) for r in tiny_logits["x"][:3]]
+    eng.flush()
+    for f in fs:
+        f.result()
+    d = stats_delta(s0)
+    assert d["aot_misses"] == 0, "ragged (batch, k) stream recompiled"
+    assert d["persistent_cache_misses"] == 0
+
+
+def test_sharded_fused_offline_parity(tiny_logits):
+    """Engine-vs-offline bitwise parity holds for the fused program too:
+    the offline scorer called with the engine's DISPATCH config runs the
+    identical jitted program (parity by construction, as in PR 9)."""
+    mesh = make_mesh(dp=1, sp=1, devices=jax.devices()[:1])
+    eng = make_sharded_logits(tiny_logits, mesh,
+                              kernel_path="blocked_scan")
+    x = tiny_logits["x"][0]
+    seed = eng._seed_counter
+    got = eng.score(x, k=17)
+    cfg_d, path, _ = eng._kernel_for("score", 17, eng.ladder.bucket_for(1))
+    assert path == "blocked_scan"
+    off = np.asarray(sharded_score_offline(
+        tiny_logits["params"], cfg_d, mesh, eng._base_key,
+        np.array([seed], np.int32), x[None], 17, k_chunk=CHUNK))[0]
+    assert np.array_equal(np.asarray(got), off)
