@@ -73,8 +73,9 @@ _STATE_CACHE = None
 
 def build_train_step() -> AuditProgram:
     """The jitted training step, donation mirroring the driver: donate is
-    gated on donation_safe() exactly as experiment.py gates it, so auditing
-    on a TPU host audits the donating program and on CPU the cache-safe one.
+    the executable store's donation_allowed() gate exactly as experiment.py
+    asks it, so auditing on a TPU host audits the donating program and on
+    CPU the cache-safe one.
     """
     import jax
     import jax.numpy as jnp
@@ -82,11 +83,12 @@ def build_train_step() -> AuditProgram:
     from iwae_replication_project_tpu.objectives import ObjectiveSpec
     from iwae_replication_project_tpu.training.train_step import (
         make_train_step)
-    from iwae_replication_project_tpu.utils.compile_cache import donation_safe
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        donation_allowed)
 
     cfg, state = _model_state()
     step = make_train_step(ObjectiveSpec(name="IWAE", k=8), cfg,
-                           donate=donation_safe())
+                           donate=donation_allowed())
     batch = jnp.zeros((16, cfg.x_dim), jnp.float32)
     return AuditProgram(
         name="train_step",

@@ -43,7 +43,7 @@ from iwae_replication_project_tpu.training.train_step import set_learning_rate
 from iwae_replication_project_tpu.utils.checkpoint import restore_latest, save_checkpoint
 from iwae_replication_project_tpu.utils.compile_cache import (
     cache_stats,
-    donation_safe,
+    donation_allowed,
     mesh_fingerprint,
     setup_persistent_cache,
     stats_delta,
@@ -165,10 +165,11 @@ def run_experiment(cfg: ExperimentConfig, max_batches_per_pass: Optional[int] = 
     # so XLA updates params/Adam moments in place instead of holding both.
     _fn_cache = {}
     stoch_bin = ds.binarization == "stochastic"
-    # donation_safe(): jaxlib-0.4.x XLA:CPU corrupts memory when donated
-    # programs are deserialized from the persistent cache — on CPU with the
-    # cache active, donation is dropped (see utils/compile_cache.py)
-    donate = cfg.donate_buffers and donation_safe()
+    # the donation-vs-cache hazard (jaxlib-0.4.x XLA:CPU corrupts memory
+    # when donated programs are deserialized from the persistent cache) is
+    # decided by the executable store — the ONE owner of executable
+    # lifetime and cache wiring; the driver only states its request
+    donate = donation_allowed(cfg.donate_buffers)
     mesh_key = mesh_fingerprint(mesh)
     # the DiagnosticsConfig gate (telemetry/diagnostics.py): a jit static
     # AND part of the AOT build key — on/off are distinct compiled programs

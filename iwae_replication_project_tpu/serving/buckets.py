@@ -148,6 +148,27 @@ class KChunkMenu:
         return -(-self.validate_k(k) // self.k_chunk)
 
 
+def validate_model(model, served) -> str:
+    """Shared unknown-model check: `model` must be a string naming one of
+    the `served` models, else ValueError (the typed ``bad_request``).
+
+    One implementation for every admission boundary — wire protocol,
+    replica router, engine submit — so "unknown model" means the same thing
+    everywhere: a request naming a model the fleet does not hold must
+    surface as a typed ``bad_request`` at the first boundary it crosses,
+    never be silently served by the wrong weights.
+    """
+    if not isinstance(model, str) or not model:
+        raise ValueError(f"model must be a non-empty string, got "
+                         f"{type(model).__name__}")
+    if model not in served:
+        raise ValueError(
+            f"unknown model {model!r}; "
+            + (f"this serving boundary holds {sorted(served)}" if served
+               else "no named models are served here"))
+    return model
+
+
 def validate_k(k, k_max: int) -> int:
     """Shared out-of-range-k check: an int in ``[1, k_max]`` or ValueError.
 

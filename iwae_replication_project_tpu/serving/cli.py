@@ -7,7 +7,13 @@ One binary, three roles:
 * **serving tier** (``--replicas N [--port P]``): N engine replicas over
   shared weights behind the TCP front end (serving/frontend/) — prints a
   ready line with the bound port, serves until stdin EOF (or SIGINT),
-  then drains gracefully and prints the final router snapshot;
+  then drains gracefully and prints the final router snapshot. With
+  ``--models NAME1,NAME2,...`` the tier is **multi-model**: one (or
+  ``--replicas``) model-labeled replica(s) per zoo preset behind the same
+  endpoint, requests selecting their tenant via the protocol ``model``
+  field, the shared executable store bounding device memory across the
+  whole zoo (``--store-budget-mb``: LRU demotion to the persistent XLA
+  cache, readmission without a fresh compile);
 * **tier client** (``--client HOST:PORT``): drive a running tier over TCP
   — synthetic ragged load by default (same ``--requests``/``--sizes``
   knobs, payload dims discovered via the ``info`` op), or
@@ -55,6 +61,24 @@ def build_argparser() -> argparse.ArgumentParser:
     src.add_argument("--preset", type=str, default=None,
                      help="zoo preset naming the architecture (fresh, "
                           "untrained weights)")
+    src.add_argument("--models", type=str, default=None,
+                     metavar="NAME1,NAME2,...",
+                     help="multi-model tier: serve SEVERAL zoo presets "
+                          "behind one endpoint (fresh weights per preset; "
+                          "requests pick a tenant via the protocol 'model' "
+                          "field, the first name is the default). Implies "
+                          "the tier mode; --replicas N runs N replicas PER "
+                          "model (default 1). The shared executable store "
+                          "bounds device memory across all of them "
+                          "(--store-budget-mb)")
+    ap.add_argument("--store-budget-mb", dest="store_budget_mb", type=float,
+                    default=None,
+                    help="device-memory budget (MiB) for the process "
+                         "executable store: past it, least-recently-used "
+                         "executables are demoted to the persistent XLA "
+                         "cache and readmitted on demand without a fresh "
+                         "compile (default: unbounded; env "
+                         "IWAE_STORE_BUDGET_BYTES)")
     ap.add_argument("--k", type=int, default=None,
                     help="importance samples per score/encode request "
                          "(default: the preset/checkpoint config's k)")
@@ -153,6 +177,11 @@ def build_argparser() -> argparse.ArgumentParser:
                       default=None,
                       help="client mode: the quota principal stamped on "
                            "requests")
+    tier.add_argument("--model", type=str, default=None,
+                      help="client mode: the tenant model stamped on every "
+                           "request (a multi-model tier routes it to that "
+                           "model's replicas; omit = the tier's default "
+                           "model)")
     tier.add_argument("--retries", type=int, default=0,
                       help="client mode: RETRIES per request after the "
                            "first attempt (reconnect + typed retryable "
@@ -248,15 +277,56 @@ def _k_split(args):
     return t, t
 
 
+def _sharded_engines(args, sources):
+    """``--sharded-replicas`` mesh engines per (model label, weight-source
+    engine) — the ONE construction both the single-model and the
+    ``--models`` paths share (mesh sizing, dp-aligned-ladder knob pops,
+    ShardedScoreEngine plumbing must never diverge between them)."""
+    import jax
+
+    from iwae_replication_project_tpu.parallel.mesh import make_mesh
+    from iwae_replication_project_tpu.serving.sharded import (
+        ShardedScoreEngine)
+
+    sp = args.mesh_sp if args.mesh_sp is not None \
+        else max(1, jax.device_count() // args.mesh_dp)
+    mesh = make_mesh(dp=args.mesh_dp, sp=sp)
+    knobs = _engine_knobs(args)
+    knobs.pop("ladder", None)   # the sharded ladder must be dp-aligned;
+    knobs.pop("max_batch", None)  # let the engine derive it
+    return [ShardedScoreEngine(
+        params=first._params, model_config=first.cfg, k=first.k,
+        mesh=mesh, k_chunk=args.k_chunk, k_max=args.k_max,
+        max_batch=args.max_batch, model=label, **knobs)
+        for label, first in sources
+        for _ in range(args.sharded_replicas)]
+
+
 def _build_replicas(args, n: int):
     """N fast engines (+ any ``--sharded-replicas`` mesh engines) over ONE
     set of weights: the first engine resolves the checkpoint/preset, the
     rest share its params and config — process-local replicas, exactly
     what the tier composes on a multi-device host with one engine (or one
-    mesh slice) per replica."""
+    mesh slice) per replica. With ``--models``, the fleet is instead one
+    (or N) model-labeled engine(s) per zoo preset — the multi-tenant
+    construction (zoo.serving_engines) — each model getting its own
+    sharded replicas over the same weights."""
     from iwae_replication_project_tpu.serving.engine import ServingEngine
 
     fast_k_max, _ = _k_split(args)
+    if args.models:
+        from iwae_replication_project_tpu import zoo
+        names = [s for s in args.models.split(",") if s]
+        engines = zoo.serving_engines(names, replicas_per_model=max(1, n),
+                                      k=args.k, **_engine_knobs(args))
+        if fast_k_max is not None:
+            for e in engines:       # the k-split applies per fast replica
+                e.k_max = max(fast_k_max, e.k)
+        if args.sharded_replicas > 0:
+            engines.extend(_sharded_engines(args, [
+                (name, next(e for e in engines if e.model == name))
+                for name in names]))
+        return engines
     first = _build_engine(args)
     if fast_k_max is not None:
         # the fast bound IS the threshold (raised as well as capped, so an
@@ -271,22 +341,7 @@ def _build_replicas(args, n: int):
             params=first._params, model_config=first.cfg, k=first.k,
             k_max=first.k_max, **_engine_knobs(args)))
     if args.sharded_replicas > 0:
-        import jax
-
-        from iwae_replication_project_tpu.parallel.mesh import make_mesh
-        from iwae_replication_project_tpu.serving.sharded import (
-            ShardedScoreEngine)
-        sp = args.mesh_sp if args.mesh_sp is not None \
-            else max(1, jax.device_count() // args.mesh_dp)
-        mesh = make_mesh(dp=args.mesh_dp, sp=sp)
-        knobs = _engine_knobs(args)
-        knobs.pop("ladder", None)   # the sharded ladder must be dp-aligned;
-        knobs.pop("max_batch", None)  # let the engine derive it
-        for _ in range(args.sharded_replicas):
-            engines.append(ShardedScoreEngine(
-                params=first._params, model_config=first.cfg, k=first.k,
-                mesh=mesh, k_chunk=args.k_chunk, k_max=args.k_max,
-                max_batch=args.max_batch, **knobs))
+        engines.extend(_sharded_engines(args, [(None, first)]))
     return engines
 
 
@@ -324,6 +379,8 @@ def _tier_mode(args, ops) -> int:
                  "large_k_threshold": info["large_k_threshold"],
                  "k_max": info["k_max"], "port": tier.port,
                  "host": args.host,
+                 "models": sorted(info["models"]),
+                 "default_model": info["default_model"],
                  "quota": info["quota"]},
         "warmup": warm,
         "buckets": info["buckets"], "k": info["k"],
@@ -396,7 +453,7 @@ def _client_k_sweep(cli, args) -> int:
         batch = (rng.rand(n, dim) > 0.5).astype(np.float32)
         t1 = time.perf_counter()
         try:
-            out = cli.score(batch.tolist(), k=k)
+            out = cli.score(batch.tolist(), k=k, model=args.model)
             rows_ok += len(out)
             walls[k].append(time.perf_counter() - t1)
         except TierError as e:
@@ -460,7 +517,7 @@ def _client_mode(args) -> int:
         n = sizes[i % len(sizes)]
         batch = (rng.rand(n, dims[op]) > 0.5).astype(np.float32) \
             if op != "decode" else rng.randn(n, dims[op]).astype(np.float32)
-        ids.append((cli.submit(op, batch.tolist()), n))
+        ids.append((cli.submit(op, batch.tolist(), model=args.model), n))
         if args.rate > 0:
             time.sleep(rng.exponential(1.0 / args.rate))
     responses = cli.drain([rid for rid, _ in ids])
@@ -556,11 +613,21 @@ def main(argv=None) -> int:
         os.sched_setaffinity(0, {args.pin_core})
 
     from iwae_replication_project_tpu.utils.compile_cache import (
-        setup_persistent_cache)
+        set_store_budget, setup_persistent_cache)
 
     # warm path: compiled serving programs persist across server restarts —
     # keyed under the checkpoint dir when serving one, else the cwd
     setup_persistent_cache(base_dir=args.checkpoint or os.getcwd())
+    if args.store_budget_mb is not None:
+        if args.store_budget_mb < 0:
+            raise SystemExit(f"--store-budget-mb {args.store_budget_mb} "
+                             f"must be >= 0 (omit the flag for unbounded)")
+        # the multi-tenant device-memory bound: LRU executables past it
+        # demote to the persistent cache above and readmit on demand
+        set_store_budget(int(args.store_budget_mb * 2 ** 20))
+
+    if args.models and args.replicas <= 0:
+        args.replicas = 1       # --models IS the tier: one replica per model
 
     if args.replicas > 0:
         return _tier_mode(args,

@@ -63,6 +63,7 @@ from iwae_replication_project_tpu.serving.buckets import (
     as_row,
     as_rows,
     validate_k,
+    validate_model,
 )
 from iwae_replication_project_tpu.serving.faults import (
     SITE_ENGINE_FETCH,
@@ -93,6 +94,9 @@ class _InFlight:
     k: int
     bucket: int
     out: Any                       # device array(s), still computing
+    #: executable-store pin held for the dispatch lifetime: the store's LRU
+    #: eviction must never pull this batch's program while it is in flight
+    pin: Any = None
 
 
 class ServingEngine:
@@ -115,7 +119,14 @@ class ServingEngine:
     disables), ``ladder`` (shape buckets; default powers-of-two up to
     max_batch), ``kernel_path`` (force the hot-loop implementation of every
     gated program: None = the probe-gated per-(op, bucket, k) selection,
-    ``"reference"`` = the historical serving pin — see :meth:`_kernel_for`).
+    ``"reference"`` = the historical serving pin — see :meth:`_kernel_for`),
+    ``model`` (the tenant label of the weights this engine serves — a zoo
+    preset name or checkpoint tag. It keys this engine's executables in the
+    process-wide capacity-bounded store (utils/compile_cache.py), labels
+    its latency histograms, and is the replica capability snapshot the
+    router's model-affinity classification reads; a submit naming a
+    DIFFERENT model is the typed ``bad_request``. ``None`` = the historical
+    single-model engine, schema-identical to pre-multi-tenant builds).
     """
 
     def __init__(self, source=None, *, params=None, model_config=None,
@@ -126,7 +137,8 @@ class ServingEngine:
                  timeout_s: Optional[float] = 2.0,
                  ladder: Optional[BucketLadder] = None, seed: int = 0,
                  metrics: Optional[ServingMetrics] = None,
-                 kernel_path: Optional[str] = None):
+                 kernel_path: Optional[str] = None,
+                 model: Optional[str] = None):
         import jax
 
         if isinstance(source, str):
@@ -166,6 +178,12 @@ class ServingEngine:
                              f"(probe-gated auto) | pallas | blocked_scan "
                              f"| reference")
         self.kernel_path_force = kernel_path
+        #: tenant label (None = single-model legacy): the executable-store
+        #: key component, the metrics label, and the router's capability bit
+        self.model = str(model) if model is not None else None
+        #: the capability set a router snapshot reads (RemoteEngine proxies
+        #: expose several; an in-process engine serves exactly one)
+        self.models = frozenset({self.model}) if self.model else None
         #: (op, k, bucket) -> (dispatch cfg, path name, tile) — the gate's
         #: per-shape memo; resolution is deterministic, so the memo only
         #: saves repeated probe-cache lookups on the dispatch hot path
@@ -190,7 +208,7 @@ class ServingEngine:
         self.ladder = ladder or BucketLadder.powers_of_two(max_batch)
         if self.ladder.max_batch != max_batch:
             max_batch = self.ladder.max_batch
-        self.metrics = metrics or ServingMetrics()
+        self.metrics = metrics or ServingMetrics(model=self.model)
         self._clock = time.monotonic
         self._batcher = MicroBatcher(max_batch=max_batch,
                                      max_wait_us=max_wait_us,
@@ -225,9 +243,16 @@ class ServingEngine:
     # ------------------------------------------------------------------
 
     def submit(self, op: str, row, k: Optional[int] = None, *,
-               seed: Optional[int] = None) -> Future:
+               seed: Optional[int] = None,
+               model: Optional[str] = None) -> Future:
         """Enqueue ONE example; returns its Future. Raises
         :class:`EngineOverloaded` when the queue bound is hit.
+
+        ``model`` asserts WHICH tenant's weights must serve the request: a
+        name other than this engine's own is the typed ``bad_request``
+        (ValueError) — a mis-routed model request must fail loudly at the
+        replica boundary, never be silently served by the wrong weights.
+        ``None`` accepts the engine's model (the single-model legacy path).
 
         ``seed`` overrides the engine's own per-request seed counter: a
         request's result is a pure function of (weights, payload, seed, k)
@@ -247,6 +272,11 @@ class ServingEngine:
         if op not in self._programs:
             raise ValueError(f"unknown op {op!r}; choose "
                              f"{sorted(self._programs)}")
+        if model is not None:
+            # the typed bad_request of the multi-tenant contract (via the
+            # ONE shared validator): the one wrong answer is serving the
+            # request with the wrong weights
+            validate_model(model, self.models or ())
         _, takes_k = self._programs[op]
         # typed bad_request for out-of-range k at the engine boundary: a k
         # past k_max must never reach program build (for the single-device
@@ -505,7 +535,7 @@ class ServingEngine:
         computes while the dispatcher returns to coalescing."""
         from iwae_replication_project_tpu.telemetry.spans import span
         from iwae_replication_project_tpu.utils.compile_cache import (
-            aot_call_async, cache_stats, stats_delta)
+            aot_call_async, cache_stats, executable_store, stats_delta)
 
         op, k = batch[0].group
         n = len(batch)
@@ -528,14 +558,26 @@ class ServingEngine:
         self.metrics.set_kernel(op, self._stamp_k(op, k), bucket,
                                 PATH_CODES[path], path, tile)
         s0 = cache_stats()
-        # spans nest: serve/dispatch/aot/serve_<op> — the outer one (in the
-        # engine's own registry) covers pad+device_put+enqueue, NOT device
-        # completion (that is the completion stage's serve/complete span)
-        with span(f"serve/dispatch/{op}", registry=self.metrics.registry):
-            out = aot_call_async(
-                self._aot_name(op), program, args,
-                kwargs=kwargs, static_kwargs=static,
-                build_key=self._build_key(op, k, bucket))
+        build_key = self._build_key(op, k, bucket)
+        # pin the dispatch's store entry until completion: a multi-tenant
+        # budget squeeze (another model's admission) must never evict an
+        # executable while this batch is between enqueue and fetch
+        pin = executable_store().pin_prefix(self.model, self._aot_name(op),
+                                            build_key)
+        try:
+            # spans nest: serve/dispatch/aot/serve_<op> — the outer one (in
+            # the engine's own registry) covers pad+device_put+enqueue, NOT
+            # device completion (that is the completion stage's
+            # serve/complete span)
+            with span(f"serve/dispatch/{op}",
+                      registry=self.metrics.registry):
+                out = aot_call_async(
+                    self._aot_name(op), program, args,
+                    kwargs=kwargs, static_kwargs=static,
+                    build_key=build_key, model=self.model)
+        except BaseException:
+            pin.release()
+            raise
         d = stats_delta(s0)
         t_disp = self._clock()
         for r in batch:
@@ -546,7 +588,8 @@ class ServingEngine:
         self.metrics.count("aot_hits", d["aot_hits"])
         self.metrics.count("aot_misses", d["aot_misses"])
         self.metrics.count("recompiles", d["persistent_cache_misses"])
-        return _InFlight(batch=batch, op=op, k=k, bucket=bucket, out=out)
+        return _InFlight(batch=batch, op=op, k=k, bucket=bucket, out=out,
+                         pin=pin)
 
     def _launch_routed(self, batch: List[Request]) -> Optional[_InFlight]:
         """:meth:`_launch` with enqueue-failure routing: an exception lands
@@ -580,10 +623,16 @@ class ServingEngine:
                 fault_point(SITE_ENGINE_FETCH, engine=self, op=inf.op)
                 out = self._fetch(inf.out)
         except Exception as e:
+            if inf.pin is not None:
+                inf.pin.release()
             for r in inf.batch:
                 self.metrics.count("errors")
                 self._complete(r.future, exc=e)
             return
+        if inf.pin is not None:
+            # the fetch landed: the dispatch is complete and the store may
+            # evict this program again under budget pressure
+            inf.pin.release()
         now = self._clock()
         for i, r in enumerate(inf.batch):
             self.metrics.record_latency(inf.op, inf.bucket, now - r.t_enqueue)
@@ -638,7 +687,8 @@ class ServingEngine:
                         aot_warm(self._aot_name(op),
                                  self._program_for(op, k, bucket), args,
                                  kwargs=kwargs, static_kwargs=static,
-                                 build_key=self._build_key(op, k, bucket))
+                                 build_key=self._build_key(op, k, bucket),
+                                 model=self.model)
                         _, path, tile = self._kernel_for(op, k, bucket)
                         self.metrics.set_kernel(op, self._stamp_k(op, k),
                                                 bucket, PATH_CODES[path],

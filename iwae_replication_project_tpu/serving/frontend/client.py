@@ -118,11 +118,14 @@ class TierClient:
     # -- pipelined API ------------------------------------------------------
 
     def submit(self, op: str, x, k: Optional[int] = None,
-               seed: Optional[int] = None) -> int:
+               seed: Optional[int] = None,
+               model: Optional[str] = None) -> int:
         """Send one request without waiting; returns its wire id. ``seed``
         (single-row payloads only) pins the row's RNG stream — the
         fleet-composition AND retry-parity hook (see protocol.py);
-        ordinary non-retrying callers leave it unset."""
+        ordinary non-retrying callers leave it unset. ``model`` names the
+        tenant whose weights must serve the request (a multi-model tier;
+        unknown names come back as typed ``bad_request`` responses)."""
         if self._sock is None:
             raise ConnectionError("client is disconnected (a prior "
                                   "connection failure); blocking requests "
@@ -134,6 +137,8 @@ class TierClient:
             req["k"] = k
         if seed is not None:
             req["seed"] = seed
+        if model is not None:
+            req["model"] = model
         if self.client_id is not None:
             req["client"] = self.client_id
         self._sock.sendall(protocol.encode_line(req))
@@ -180,27 +185,32 @@ class TierClient:
     # -- blocking API -------------------------------------------------------
 
     def request(self, op: str, x, k: Optional[int] = None,
-                seed: Optional[int] = None) -> List[Any]:
+                seed: Optional[int] = None,
+                model: Optional[str] = None) -> List[Any]:
         if self._retry is None:
-            return self.wait(self.submit(op, x, k=k, seed=seed))
-        return self._request_retrying(op, x, k, seed)
+            return self.wait(self.submit(op, x, k=k, seed=seed, model=model))
+        return self._request_retrying(op, x, k, seed, model)
 
     def score(self, x, k: Optional[int] = None,
-              seed: Optional[int] = None) -> List[Any]:
+              seed: Optional[int] = None,
+              model: Optional[str] = None) -> List[Any]:
         """Per-row k-sample IWAE log p̂(x) (list of floats)."""
-        return self.request("score", x, k=k, seed=seed)
+        return self.request("score", x, k=k, seed=seed, model=model)
 
     def encode(self, x, k: Optional[int] = None,
-               seed: Optional[int] = None) -> List[Any]:
-        return self.request("encode", x, k=k, seed=seed)
+               seed: Optional[int] = None,
+               model: Optional[str] = None) -> List[Any]:
+        return self.request("encode", x, k=k, seed=seed, model=model)
 
-    def decode(self, h, seed: Optional[int] = None) -> List[Any]:
-        return self.request("decode", h, seed=seed)
+    def decode(self, h, seed: Optional[int] = None,
+               model: Optional[str] = None) -> List[Any]:
+        return self.request("decode", h, seed=seed, model=model)
 
     # -- retry/hedging machinery (blocking path only) -----------------------
 
     def _request_retrying(self, op: str, x, k: Optional[int],
-                          seed: Optional[int]) -> List[Any]:
+                          seed: Optional[int],
+                          model: Optional[str] = None) -> List[Any]:
         """The RetryPolicy loop: reconnect + resend across connection
         failures, back off and resend on typed retryable errors, give up
         at max_attempts or the overall deadline — whichever first. Raises
@@ -216,8 +226,8 @@ class TierClient:
             hint = None
             try:
                 self._ensure_connected()
-                rid = self.submit(op, x, k=k, seed=seed)
-                return self._await(rid, op, x, k, seed, deadline)
+                rid = self.submit(op, x, k=k, seed=seed, model=model)
+                return self._await(rid, op, x, k, seed, model, deadline)
             except TierError as e:
                 if not policy.retryable(e.code) or (
                         e.code == "quota_exceeded"
@@ -247,7 +257,7 @@ class TierClient:
             time.sleep(sleep_s)
         raise last
 
-    def _await(self, rid: int, op: str, x, k, seed,
+    def _await(self, rid: int, op: str, x, k, seed, model,
                deadline: Optional[float]) -> List[Any]:
         """Wait for `rid`, hedging to a second connection when the policy
         asks for it and the primary is slow."""
@@ -275,7 +285,7 @@ class TierClient:
         # it: a submit that dies on a freshly-reset connection must not
         # leak the hedge socket (nor skip the primary cleanup decision)
         try:
-            hrid = hedge.submit(op, x, k=k, seed=seed)
+            hrid = hedge.submit(op, x, k=k, seed=seed, model=model)
             results: "_queue.Queue" = _queue.Queue()
 
             def waiter(tag: str, cli: "TierClient", r: int) -> None:

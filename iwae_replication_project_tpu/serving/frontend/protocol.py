@@ -11,7 +11,16 @@ Request object::
      "k": 50,                                # optional (score/encode only)
      "id": <any JSON value>,                 # optional, echoed verbatim
      "client": "tenant-a",                   # optional quota principal
+     "model": "table1-iwae-1l-k50",          # optional tenant model
      "seed": 17}                             # optional, single-row only
+
+``model`` names WHICH zoo model's weights must serve the request on a
+multi-model tier (``iwae-serve --models``): the router classifies it onto
+replicas holding that model, quotas meter per (client, model), and a model
+the fleet does not declare is a typed ``bad_request`` — never a silent
+answer from the wrong weights. Absent, the tier's ``default_model``
+serves (the ``info`` doc names it, plus a per-model capability table under
+``models``).
 
 ``seed`` is the fleet-composition hook: serving results are a pure function
 of (weights, payload, seed, k), so a PARENT router that mints its own seeds

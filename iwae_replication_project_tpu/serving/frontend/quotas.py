@@ -1,12 +1,17 @@
-"""Per-client admission quotas: the token-bucket state machine.
+"""Per-(client, model) admission quotas: the token-bucket state machine.
 
-Each client id (the request's ``client`` field; absent = the shared
-``"anonymous"`` principal) owns one token bucket refilled continuously at
-``rate`` tokens/sec up to ``burst`` capacity; admitting a request costs one
-token per payload row. A dry bucket rejects with :class:`QuotaExceeded` —
-which the front end turns into a typed ``quota_exceeded`` response, NOT a
-dropped connection — and rejection never consumes tokens, so a throttled
-client recovers after exactly ``cost / rate`` seconds of restraint.
+Each (client id, model) principal — the request's ``client`` field (absent
+= the shared ``"anonymous"`` principal) crossed with its ``model`` field
+(absent = the unlabeled default) — owns one token bucket refilled
+continuously at ``rate`` tokens/sec up to ``burst`` capacity; admitting a
+request costs one token per payload row. The model axis makes quotas
+multi-tenant: one client's traffic against model A cannot exhaust its —
+or anyone's — budget for model B, so a zoo-serving tier degrades per
+(tenant, model) rather than globally. A dry bucket rejects with
+:class:`QuotaExceeded` — which the front end turns into a typed
+``quota_exceeded`` response, NOT a dropped connection — and rejection
+never consumes tokens, so a throttled client recovers after exactly
+``cost / rate`` seconds of restraint.
 
 The quota layer sits ABOVE the router on purpose: client identity is an
 admission-time concern only. Once admitted, a request carries no client
@@ -75,26 +80,35 @@ class ClientQuotas:
         self._policy = policy
         self._clock = clock
         self._lock = threading.Lock()
-        #: client -> [tokens, last_refill_time]; guarded by _lock
-        self._buckets: Dict[str, List[float]] = {}
+        #: (client, model) -> [tokens, last_refill_time]; guarded by _lock
+        self._buckets: Dict[tuple, List[float]] = {}
 
     @property
     def enabled(self) -> bool:
         return self._policy is not None
 
-    def _refilled(self, client: str, now: float) -> List[float]:
-        """The client's bucket, refilled to `now` (caller holds _lock)."""
+    @staticmethod
+    def _principal(client: Optional[str],
+                   model: Optional[str]) -> tuple:
+        """The bucket key: (client, model) — model=None is the unlabeled
+        default lane, distinct from every named model's lane."""
+        return (client or DEFAULT_CLIENT, model)
+
+    def _refilled(self, principal: tuple, now: float) -> List[float]:
+        """The principal's bucket, refilled to `now` (caller holds _lock)."""
         p = self._policy
-        b = self._buckets.get(client)
+        b = self._buckets.get(principal)
         if b is None:
-            b = self._buckets.setdefault(client, [p.burst, now])
+            b = self._buckets.setdefault(principal, [p.burst, now])
         else:
             b[0] = min(p.burst, b[0] + (now - b[1]) * p.rate)
             b[1] = now
         return b
 
-    def admit(self, client: Optional[str], cost: float) -> None:
-        """Charge `cost` tokens to `client` or raise :class:`QuotaExceeded`.
+    def admit(self, client: Optional[str], cost: float,
+              model: Optional[str] = None) -> None:
+        """Charge `cost` tokens to the (client, model) principal or raise
+        :class:`QuotaExceeded`.
 
         A rejected request consumes nothing. A cost above ``burst`` can
         never be admitted and says so explicitly — the client must split
@@ -102,43 +116,50 @@ class ClientQuotas:
         """
         if self._policy is None:
             return
-        client = client or DEFAULT_CLIENT
+        principal = self._principal(client, model)
         if cost > self._policy.burst:
             raise QuotaExceeded(
                 f"request cost {cost:g} rows exceeds the per-client burst "
                 f"capacity {self._policy.burst:g} — split the request")
         with self._lock:
-            b = self._refilled(client, self._clock())
+            b = self._refilled(principal, self._clock())
             if b[0] < cost:
                 wait = (cost - b[0]) / self._policy.rate
+                lane = f" (model {model!r})" if model is not None else ""
                 raise QuotaExceeded(
-                    f"client {client!r} quota exhausted "
+                    f"client {principal[0]!r}{lane} quota exhausted "
                     f"({b[0]:.2f}/{self._policy.burst:g} tokens, cost "
                     f"{cost:g}); retry in ~{wait:.2f}s",
                     retry_after_s=wait)
             b[0] -= cost
 
-    def refund(self, client: Optional[str], cost: float) -> None:
-        """Return `cost` tokens to `client` (clamped at burst): the undo
-        for an :meth:`admit` whose request the tier then failed to serve —
-        a typed routing rejection (ceiling, fleet-wide shed, draining)
-        must not burn the client's budget, or sustained overload would
-        stack ``quota_exceeded`` on top of ``overloaded`` and break the
-        documented cost/rate recovery accounting."""
+    def refund(self, client: Optional[str], cost: float,
+               model: Optional[str] = None) -> None:
+        """Return `cost` tokens to the (client, model) principal (clamped
+        at burst): the undo for an :meth:`admit` whose request the tier
+        then failed to serve — a typed routing rejection (ceiling,
+        fleet-wide shed, draining) must not burn the client's budget, or
+        sustained overload would stack ``quota_exceeded`` on top of
+        ``overloaded`` and break the documented cost/rate recovery
+        accounting."""
         if self._policy is None:
             return
         with self._lock:
-            b = self._refilled(client or DEFAULT_CLIENT, self._clock())
+            b = self._refilled(self._principal(client, model), self._clock())
             b[0] = min(self._policy.burst, b[0] + cost)
 
-    def tokens(self, client: Optional[str]) -> Optional[float]:
-        """Current refilled token balance (None when quotas are off) —
-        introspection for tests and the tier's snapshot."""
+    def tokens(self, client: Optional[str],
+               model: Optional[str] = None) -> Optional[float]:
+        """Current refilled token balance of one (client, model) principal
+        (None when quotas are off) — introspection for tests and the
+        tier's snapshot."""
         if self._policy is None:
             return None
         with self._lock:
-            return self._refilled(client or DEFAULT_CLIENT, self._clock())[0]
+            return self._refilled(self._principal(client, model),
+                                  self._clock())[0]
 
     def clients(self) -> List[str]:
+        """Distinct client ids with live buckets (any model lane)."""
         with self._lock:
-            return sorted(self._buckets)
+            return sorted({c for c, _m in self._buckets})

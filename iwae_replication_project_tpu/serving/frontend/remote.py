@@ -60,6 +60,7 @@ from iwae_replication_project_tpu.serving.batcher import (
     RequestTimeout,
     complete_future as _complete,
 )
+from iwae_replication_project_tpu.serving.buckets import validate_model
 from iwae_replication_project_tpu.serving.faults import (
     SITE_REMOTE_SEND,
     fault_point,
@@ -150,6 +151,13 @@ class RemoteEngine:
         self.k_max = doc.get("k_max")
         self.sharded = bool(doc.get("sharded_replicas")) and \
             doc.get("sharded_replicas") == doc.get("replicas")
+        # model capability forwarding: a multi-tenant child tier declares
+        # its zoo in the info doc — the proxy presents the WHOLE set to a
+        # parent router (one RemoteEngine can serve several models), with
+        # the child's default as its own default label
+        child_models = doc.get("models") or {}
+        self.models = frozenset(child_models) if child_models else None
+        self.model = doc.get("default_model")
         self.info = doc
         self._sock = sock
         self._reader = reader
@@ -214,18 +222,25 @@ class RemoteEngine:
     # -- engine surface ------------------------------------------------------
 
     def submit(self, op: str, row, k: Optional[int] = None, *,
-               seed: Optional[int] = None) -> Future:
+               seed: Optional[int] = None,
+               model: Optional[str] = None) -> Future:
         """One row to the child tier; returns the proxy Future.
 
-        Validation (unknown op, wrong feature count, poisoned connection)
-        raises synchronously, exactly like the in-process engine — the
-        parent router's submit-failure path handles it. Under a
-        ``RetryPolicy`` a poisoned proxy first attempts one (backoff-
+        Validation (unknown op/model, wrong feature count, poisoned
+        connection) raises synchronously, exactly like the in-process
+        engine — the parent router's submit-failure path handles it. Under
+        a ``RetryPolicy`` a poisoned proxy first attempts one (backoff-
         limited) reconnect, so the parent's warm probes drive recovery.
+        ``model`` rides the wire's ``model`` field, so a parent fleet's
+        model routing reaches the child tier's replicas unchanged.
         """
         if op not in self.row_dims:
             raise ValueError(
                 f"unknown op {op!r}; this tier serves {sorted(self.row_dims)}")
+        if model is not None:
+            # the in-process engine's typed bad_request, via the ONE
+            # shared validator: the child tier must hold these weights
+            validate_model(model, self.models or ())
         row = row.tolist() if hasattr(row, "tolist") else list(row)
         if len(row) != self.row_dims[op]:
             raise ValueError(f"op {op!r} rows have {self.row_dims[op]} "
@@ -233,6 +248,8 @@ class RemoteEngine:
         req: Dict[str, Any] = {"op": op, "x": row}
         if k is not None:
             req["k"] = int(k)
+        if model is not None:
+            req["model"] = model
         if seed is not None:
             seed = int(seed)
             if not 0 <= seed < 2 ** 31:

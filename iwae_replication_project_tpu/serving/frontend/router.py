@@ -18,6 +18,17 @@ id) and the per-replica engines:
   keep flowing to the replica whose AOT/jit caches (and, on hardware, its
   device-resident executables) are already warm for that bucket — load
   imbalance beyond the slack overrides affinity;
+* **model-affinity classification** — a fleet may serve a whole model zoo
+  (multi-tenant replicas expose ``engine.model`` / ``engine.models`` — the
+  capability snapshot, same machinery as the large-k bits below). A
+  request naming a model is eligible only for replicas that hold that
+  model's weights; a model no replica declares is a synchronous ValueError
+  (the typed ``bad_request`` upstream — it must never be served by the
+  wrong weights). Model-less requests in a multi-model fleet resolve to
+  the tier's ``default_model`` (the first replica's) at admission, so
+  results stay a pure function of the request, never of routing;
+  the affinity groups below are keyed (model, op, k), so each tenant's
+  traffic keeps hitting the replica whose store entries are warm for it;
 * **large-k classification** — a fleet may mix single-device replicas with
   mesh-backed :class:`~..sharded.ShardedScoreEngine` replicas
   (``engine.sharded``). A ``score`` request with k above
@@ -75,7 +86,10 @@ from iwae_replication_project_tpu.serving.batcher import (
     RequestTimeout,
     complete_future,
 )
-from iwae_replication_project_tpu.serving.buckets import validate_k
+from iwae_replication_project_tpu.serving.buckets import (
+    validate_k,
+    validate_model,
+)
 from iwae_replication_project_tpu.serving.faults import (
     SITE_ROUTER_DISPATCH,
     fault_point,
@@ -103,6 +117,8 @@ class _Tracked:
     k: Optional[int]
     seed: int
     future: Future
+    #: resolved tenant (None only in an unlabeled single-model fleet)
+    model: Optional[str] = None
     attempts: int = 0
     replica_index: int = -1
     t_dispatch: float = 0.0
@@ -118,7 +134,7 @@ class _Replica:
     fleet has one synchronization domain, not N+1."""
 
     __slots__ = ("index", "engine", "healthy", "outstanding", "last_error",
-                 "sharded", "k_max", "ops")
+                 "sharded", "k_max", "ops", "model", "models")
 
     def __init__(self, index: int, engine):
         self.index = index
@@ -135,9 +151,26 @@ class _Replica:
         dims = getattr(engine, "row_dims", None)
         self.ops: Optional[frozenset] = \
             frozenset(dims) if dims is not None else None
+        # model capability: the replica's default tenant plus the full set
+        # it holds weights for (RemoteEngine proxies forward a child tier's
+        # whole zoo). Neither attribute -> unlabeled (the single-model
+        # legacy replica: serves model-less traffic only).
+        self.model: Optional[str] = getattr(engine, "model", None)
+        ms = getattr(engine, "models", None)
+        self.models: Optional[frozenset] = \
+            frozenset(ms) if ms else \
+            (frozenset({self.model}) if self.model is not None else None)
 
     def serves(self, op: str) -> bool:
         return self.ops is None or op in self.ops
+
+    def serves_model(self, model: Optional[str]) -> bool:
+        """Whether this replica's weights may serve `model`: labeled
+        replicas serve exactly their declared set; unlabeled replicas serve
+        model-less traffic (the single-model legacy fleet)."""
+        if model is None:
+            return self.models is None
+        return self.models is not None and model in self.models
 
 
 class ReplicaRouter:
@@ -200,7 +233,19 @@ class ReplicaRouter:
         #: ValueError (typed bad_request), never an internal error
         k_maxes = [r.k_max for r in self._replicas if r.k_max is not None]
         self.k_max: Optional[int] = max(k_maxes) if k_maxes else None
-        self._affinity: Dict[Tuple[str, Optional[int]], int] = {}
+        #: the union of declared model capabilities over the fleet (empty =
+        #: unlabeled single-model fleet) — the typed-bad_request universe
+        self.models: frozenset = frozenset().union(
+            *(r.models for r in self._replicas if r.models is not None)) \
+            if any(r.models for r in self._replicas) else frozenset()
+        #: whether any replica still serves model-less traffic
+        self._has_unlabeled = any(r.models is None for r in self._replicas)
+        #: where a model-less request lands in an all-labeled fleet: the
+        #: FIRST replica's default model — resolved at admission so results
+        #: are a pure function of the request, never of replica choice
+        self.default_model: Optional[str] = next(
+            (r.model for r in self._replicas if r.model is not None), None)
+        self._affinity: Dict[Tuple, int] = {}
         self._seed_counter = 0
         self._ticket_counter = 0
         self._outstanding_total = 0
@@ -250,12 +295,19 @@ class ReplicaRouter:
     # -- request intake ----------------------------------------------------
 
     def submit(self, op: str, row, k: Optional[int] = None, *,
-               seed: Optional[int] = None) -> Future:
+               seed: Optional[int] = None,
+               model: Optional[str] = None) -> Future:
         """Admit and dispatch one request row; returns the tier Future.
 
+        ``model`` names the tenant whose weights must serve the row; a
+        model no replica declares is a synchronous ValueError (the typed
+        ``bad_request`` upstream). ``None`` resolves to the fleet's
+        ``default_model`` when every replica is model-labeled (a
+        multi-model fleet must not let replica choice pick the weights).
+
         Raises synchronously for non-serving outcomes the caller must turn
-        into typed responses: ValueError (bad payload/op, via the engine's
-        own validation), :class:`TierOverloaded` (ceiling),
+        into typed responses: ValueError (bad payload/op/model, via the
+        engine's own validation), :class:`TierOverloaded` (ceiling),
         :class:`EngineOverloaded` (every healthy replica shed), and
         :class:`ReplicaUnavailable` (no healthy replica / draining). Once
         a Future is returned, it ALWAYS completes — with a result, or with
@@ -269,6 +321,7 @@ class ReplicaRouter:
                                           if r.ops is not None)))
             raise ValueError(f"unknown op {op!r}; this fleet serves "
                              f"{served}")
+        model = self.resolve_model(model)
         if k is not None:
             # typed bad_request at the tier boundary: an out-of-range k is
             # rejected HERE, before it can occupy the ceiling or reach a
@@ -291,7 +344,7 @@ class ReplicaRouter:
                 self._seed_counter = (self._seed_counter + 1) % (2 ** 31)
             self._ticket_counter += 1
             t = _Tracked(ticket=self._ticket_counter, op=op, row=row, k=k,
-                         seed=int(seed), future=fut)
+                         seed=int(seed), future=fut, model=model)
             self._outstanding_total += 1
             self.registry.gauge("router/outstanding").set(
                 self._outstanding_total)
@@ -303,6 +356,22 @@ class ReplicaRouter:
         self._count("routed")
         return fut
 
+    def resolve_model(self, model: Optional[str]) -> Optional[str]:
+        """The ONE model-resolution step: validate a named model against
+        the fleet's declared capability set (unknown = ValueError, the
+        typed ``bad_request`` — rejected before it can occupy the ceiling,
+        because the one wrong outcome is the wrong weights answering), and
+        pin a model-less request to ``default_model`` in an all-labeled
+        fleet (so results never depend on which replica the balancer
+        picked). The front end resolves BEFORE quota admission with this
+        same call, so default-model traffic and explicitly-named traffic
+        meter through one (client, model) lane."""
+        if model is not None:
+            return validate_model(model, self.models)
+        if not self._has_unlabeled:
+            return self.default_model
+        return None
+
     # -- selection + dispatch ----------------------------------------------
 
     def _wants_sharded(self, op: str, k: Optional[int]) -> bool:
@@ -311,12 +380,14 @@ class ReplicaRouter:
         return (op == "score" and self.large_k_threshold is not None
                 and k is not None and k > self.large_k_threshold)
 
-    def _eligible(self, r: _Replica, op: str, k: Optional[int]) -> bool:
-        """Capability filter under the classification policy: large-k score
-        needs a sharded replica; small traffic keeps the fast path (sharded
+    def _eligible(self, r: _Replica, op: str, k: Optional[int],
+                  model: Optional[str] = None) -> bool:
+        """Capability filter under the classification policy: the replica
+        must hold the request's model weights; large-k score needs a
+        sharded replica; small traffic keeps the fast path (sharded
         replicas pick it up only in an all-sharded fleet); a replica never
         sees an op it does not serve or a k above its own bound."""
-        if not r.serves(op):
+        if not r.serves(op) or not r.serves_model(model):
             return False
         if r.k_max is not None and k is not None and k > r.k_max:
             return False
@@ -324,15 +395,15 @@ class ReplicaRouter:
             return r.sharded
         return not r.sharded or not self._has_fast
 
-    def _select(self, group: Tuple[str, Optional[int]],
+    def _select(self, group: Tuple,
                 exclude: Set[int]) -> Optional[_Replica]:
         """Pick a replica (caller holds the lock): sticky group affinity
         while balanced, else least-inflight with lowest-index tie-break —
-        over the replicas eligible for this (op, k) class."""
-        op, k = group
+        over the replicas eligible for this (model, op, k) class."""
+        model, op, k = group
         cands = [r for r in self._replicas
                  if r.healthy and r.index not in exclude
-                 and self._eligible(r, op, k)]
+                 and self._eligible(r, op, k, model)]
         if not cands:
             return None
         least = min(len(r.outstanding) for r in cands)
@@ -340,7 +411,7 @@ class ReplicaRouter:
         if aff is not None:
             ar = self._replicas[aff]
             if ar.healthy and aff not in exclude and \
-                    self._eligible(ar, op, k) and \
+                    self._eligible(ar, op, k, model) and \
                     len(ar.outstanding) <= least + self.affinity_slack:
                 self._count("affinity_hits")
                 return ar
@@ -354,7 +425,7 @@ class ReplicaRouter:
         any_shed = False
         while True:
             with self._lock:
-                r = self._select((t.op, t.k), exclude)
+                r = self._select((t.model, t.op, t.k), exclude)
                 if r is None:
                     break
                 r.outstanding[t.ticket] = t
@@ -369,8 +440,13 @@ class ReplicaRouter:
                             replica=r.index, attempt=t.attempts)
                 # outside the lock: engine.submit takes the engine's own
                 # lock and may block briefly; the router lock never nests
-                # around foreign blocking work
-                ef = r.engine.submit(t.op, t.row, k=t.k, seed=t.seed)
+                # around foreign blocking work. The model rides along only
+                # when resolved — legacy fakes/engines keep their signature.
+                if t.model is not None:
+                    ef = r.engine.submit(t.op, t.row, k=t.k, seed=t.seed,
+                                         model=t.model)
+                else:
+                    ef = r.engine.submit(t.op, t.row, k=t.k, seed=t.seed)
             except EngineOverloaded:
                 any_shed = True
                 self._unplace(t, r)
@@ -536,8 +612,12 @@ class ReplicaRouter:
                 op = self.probe_op if r.serves(self.probe_op) \
                     else sorted(r.engine.row_dims)[0]
                 probe_row = [0.0] * r.engine.row_dims[op]
+                # a labeled replica is probed under its own model so the
+                # probe exercises the same store entries live traffic hits
+                kw = {"model": r.model} if r.model is not None else {}
                 ef = r.engine.submit(op, probe_row,
-                                     k=getattr(r.engine, "k", None), seed=0)
+                                     k=getattr(r.engine, "k", None), seed=0,
+                                     **kw)
                 ef.result(timeout=self.probe_timeout_s)
             except Exception:
                 continue      # still down; next monitor tick retries

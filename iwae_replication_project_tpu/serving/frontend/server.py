@@ -149,6 +149,16 @@ class _Connection:
                 raise protocol.ProtocolError(
                     f"'client' must be a string, got "
                     f"{type(client).__name__}")
+            model = obj.get("model")
+            # the protocol surface of the typed unknown-model contract —
+            # and the quota-lane resolution: a model-less request in an
+            # all-labeled fleet resolves to the default model HERE, before
+            # admission, so default and explicitly-named traffic meter
+            # through the SAME (client, model) bucket
+            try:
+                model = self._tier.router.resolve_model(model)
+            except ValueError as e:
+                raise protocol.ProtocolError(str(e)) from None
             k = obj.get("k")
             if k is not None:
                 # the protocol surface of the typed out-of-range-k
@@ -178,7 +188,7 @@ class _Connection:
                 if len(rows) != 1:
                     raise protocol.ProtocolError(
                         "'seed' applies to single-row payloads only")
-            self._tier.admit(client, len(rows))
+            self._tier.admit(client, len(rows), model=model)
             pending = _Pending(req_id, len(rows))
             with self._lock:
                 self._pending += 1
@@ -186,13 +196,14 @@ class _Connection:
             try:
                 for row in rows:
                     futures.append(
-                        self._tier.router.submit(op, row, k=k, seed=seed))
+                        self._tier.router.submit(op, row, k=k, seed=seed,
+                                                 model=model))
             except Exception:
                 # partial admission: rows already routed complete and are
                 # discarded; the request as a unit gets the typed error —
                 # and its full quota cost back (the client pays for served
                 # requests, not for shed/rejected ones)
-                self._tier.refund(client, len(rows))
+                self._tier.refund(client, len(rows), model=model)
                 with self._lock:
                     self._pending -= 1
                     self._idle.notify_all()
@@ -241,10 +252,14 @@ class _Connection:
 
 def _engine_counters(engine) -> Dict[str, Any]:
     """One replica engine's counter snapshot for :meth:`ServingTier.stats`
-    (fakes without a metrics registry report empty)."""
+    (fakes without a metrics registry report empty). Reads the counter
+    block only — the full ``snapshot()`` would rebuild the process-wide
+    store section once per replica just to discard it."""
     metrics = getattr(engine, "metrics", None)
     if metrics is None:
         return {}
+    if hasattr(metrics, "counters"):
+        return dict(metrics.counters())
     return dict(metrics.snapshot()["counters"])
 
 
@@ -303,20 +318,24 @@ class ServingTier:
 
     # -- admission ----------------------------------------------------------
 
-    def admit(self, client: Optional[str], cost: int) -> None:
-        """Per-client token-bucket admission (the router applies the global
-        ceiling itself at submit). Raises :class:`QuotaExceeded`."""
+    def admit(self, client: Optional[str], cost: int,
+              model: Optional[str] = None) -> None:
+        """Per-(client, model) token-bucket admission (the router applies
+        the global ceiling itself at submit). Raises
+        :class:`QuotaExceeded` — one tenant's model cannot starve another's
+        budget under the same client id."""
         try:
-            self.quotas.admit(client, cost)
+            self.quotas.admit(client, cost, model=model)
         except QuotaExceeded:
             self.registry.counter("router/quota_rejections").inc()
             raise
 
-    def refund(self, client: Optional[str], cost: int) -> None:
+    def refund(self, client: Optional[str], cost: int,
+               model: Optional[str] = None) -> None:
         """Return an admitted request's tokens when routing rejected it
         (ceiling/shed/unavailable): the quota meters served work, so a
         request whose response is a typed routing error costs nothing."""
-        self.quotas.refund(client, cost)
+        self.quotas.refund(client, cost, model=model)
 
     # -- info ---------------------------------------------------------------
 
@@ -336,9 +355,31 @@ class ServingTier:
         fast_t = next((e for e in engines
                        if not getattr(e, "sharded", False)),
                       engines[0])
+        # per-model capability sub-docs (the multi-tenant contract clients
+        # and RemoteEngine proxies read): which models this fleet holds,
+        # each with its own ops/dims/k — empty for an unlabeled fleet
+        models: Dict[str, Any] = {}
+        for e in engines:
+            m = getattr(e, "model", None)
+            if m is None:
+                continue
+            doc = models.setdefault(m, {"ops": set(), "row_dims": {},
+                                        "k": getattr(e, "k", None),
+                                        "k_max": getattr(e, "k_max", None),
+                                        "replicas": 0})
+            doc["ops"].update(getattr(e, "row_dims", {}))
+            doc["row_dims"].update(getattr(e, "row_dims", {}))
+            doc["replicas"] += 1
+            if getattr(e, "k_max", None) is not None and \
+                    doc["k_max"] is not None:
+                doc["k_max"] = max(doc["k_max"], e.k_max)
+        for doc in models.values():
+            doc["ops"] = sorted(doc["ops"])
         return {
             "ops": sorted(row_dims),
             "row_dims": row_dims,
+            "models": models,
+            "default_model": self.router.default_model,
             "k": getattr(fast_t, "k", None),
             "k_max": self.router.k_max,
             "large_k_threshold": self.router.large_k_threshold,
@@ -364,11 +405,19 @@ class ServingTier:
         over-the-wire view the bench's zero-recompile proof and the smoke's
         failure accounting read (same numbers the CLI prints at shutdown)."""
         snap = self.registry.snapshot()
+        # the process executable store's counters ride the stats document
+        # so the multi-model smoke/bench read hit/miss/eviction/readmit
+        # accounting over the wire (import deferred to the call: the store
+        # module is jax-free, but the tier's import surface stays minimal)
+        from iwae_replication_project_tpu.utils.compile_cache import (
+            store_stats)
+        store = store_stats()
         return {
             "router": {name: v for name, v in snap["counters"].items()
                        if name.startswith("router/")},
             "gauges": {name: v for name, v in snap["gauges"].items()
                        if name.startswith("router/")},
+            "store": store,
             "replicas": self.router.replica_states(),
             "engines": [_engine_counters(e) for e in self.router.engines],
         }

@@ -52,14 +52,29 @@ class LatencyHistogram(Histogram):
 
 
 class ServingMetrics:
-    """Thread-safe engine counters + per-(op, bucket) latency histograms."""
+    """Thread-safe engine counters + per-(op, bucket) latency histograms.
+
+    ``model`` labels a multi-tenant engine's histograms: latency keys become
+    ``latency/<model>/<op>/b<bucket>`` (flat/Prometheus alike) so one
+    exposition page over a zoo-serving tier separates tenants. ``None`` (the
+    single-model default) keeps the historical unlabeled schema byte-for-
+    byte. Snapshots additionally carry the process-wide executable-store
+    section (``store``: hits/misses/evictions/demotions/readmits,
+    resident-vs-budget bytes — utils/compile_cache.store_stats())."""
 
     COUNTERS = ("submitted", "completed", "timeouts", "shed", "errors",
                 "dispatches", "real_rows", "padded_rows",
                 "aot_hits", "aot_misses", "recompiles")
 
-    def __init__(self, registry: Optional[MetricRegistry] = None):
+    #: the store keys exported flat (floats only; budget may be None and is
+    #: flat-exported only when set)
+    STORE_FLAT = ("hits", "misses", "evictions", "demotions", "readmits",
+                  "resident_bytes", "entries")
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 model: Optional[str] = None):
         self.registry = registry if registry is not None else MetricRegistry()
+        self.model = model
         # pre-register so snapshots carry every counter from the first call
         for name in self.COUNTERS:
             self.registry.counter(name)
@@ -74,6 +89,13 @@ class ServingMetrics:
 
     def count(self, name: str, n: float = 1) -> None:
         self.registry.counter(name).inc(n)
+
+    def counters(self) -> Dict[str, float]:
+        """Just the engine counter block of :meth:`snapshot` — what the
+        tier's wire ``stats`` op reads per replica, without building the
+        process-wide store section N times over."""
+        snap = self.registry.snapshot()
+        return {k: snap["counters"].get(k, 0) for k in self.COUNTERS}
 
     def set_queue_depth(self, depth: int) -> None:
         self._queue_depth.set(int(depth))
@@ -106,17 +128,23 @@ class ServingMetrics:
                 "tile": list(tile) if tile is not None else None,
             }
 
+    def _hist_key(self, op: str, bucket: int) -> str:
+        """The per-(op, bucket) histogram key, model-labeled when this
+        engine serves a named tenant."""
+        return f"{self.model}/{op}/b{bucket}" if self.model \
+            else f"{op}/b{bucket}"
+
     def record_latency(self, op: str, bucket: int, seconds: float) -> None:
-        self.registry.histogram(f"{_LAT}{op}/b{bucket}",
+        self.registry.histogram(f"{_LAT}{self._hist_key(op, bucket)}",
                                 factory=LatencyHistogram).record(seconds)
 
     def record_queue_wait(self, op: str, bucket: int, seconds: float) -> None:
-        self.registry.histogram(f"{_QW}{op}/b{bucket}",
+        self.registry.histogram(f"{_QW}{self._hist_key(op, bucket)}",
                                 factory=LatencyHistogram).record(seconds)
 
     def record_device_wait(self, op: str, bucket: int,
                            seconds: float) -> None:
-        self.registry.histogram(f"{_DW}{op}/b{bucket}",
+        self.registry.histogram(f"{_DW}{self._hist_key(op, bucket)}",
                                 factory=LatencyHistogram).record(seconds)
 
     # -- export ------------------------------------------------------------
@@ -139,7 +167,22 @@ class ServingMetrics:
 
         with self._kernel_lock:
             kernel = {key: dict(rec) for key, rec in self._kernel.items()}
+        # the process-wide executable-store section (capacity-bounded AOT
+        # store, utils/compile_cache.py): one store serves every engine in
+        # the process, so the numbers are global by design — stamped on
+        # each snapshot so the wire `stats` op and the bench artifacts see
+        # residency-vs-budget next to the per-engine counters
+        from iwae_replication_project_tpu.utils.compile_cache import (
+            store_stats)
+        st = store_stats()
+        store = {k: st[k] for k in ("hits", "misses", "evictions",
+                                    "demotions", "readmits",
+                                    "resident_bytes", "budget_bytes",
+                                    "entries")}
+        store["per_model"] = st["per_model"]
         return {
+            "model": self.model,
+            "store": store,
             "counters": c,
             "queue_depth": int(snap["gauges"].get("queue_depth", 0)),
             "inflight": int(snap["gauges"].get("inflight", 0)),
@@ -168,6 +211,10 @@ class ServingMetrics:
         out["inflight"] = float(snap["inflight"])
         out["kernel_path"] = float(snap["kernel_path"])
         out["padding_waste"] = float(snap["padding_waste"])
+        for key in self.STORE_FLAT:
+            out[f"store/{key}"] = float(snap["store"][key])
+        if snap["store"]["budget_bytes"] is not None:
+            out["store/budget_bytes"] = float(snap["store"]["budget_bytes"])
         for key, rec in snap["kernel"].items():
             out[f"kernel/{key}/path_code"] = float(rec["path_code"])
         for kind in ("latency", "queue_wait", "device_wait"):

@@ -11,19 +11,40 @@ module makes every production entry point compile-once, run-warm:
   calls this instead of hand-rolling ``jax.config.update`` — a lint-guard test
   (tests/test_compile_cache.py) enforces it.
 
-* an **AOT executable registry** (:func:`warm_callable`, :func:`aot_call`,
-  :func:`aot_call_async` — the explicitly-async variant pipelined callers
-  hold device results from) —
-  ``.lower().compile()`` runs once per ``(program, static build key, arg
-  shapes/dtypes/shardings)`` signature and the compiled executable is reused
+* the **executable store** (:class:`ExecutableStore`; module-level default
+  behind :func:`warm_callable`, :func:`aot_call`, :func:`aot_call_async` —
+  the explicitly-async variant pipelined callers hold device results from) —
+  ``.lower().compile()`` runs once per ``(model, program, static build key,
+  arg shapes/dtypes/shardings)`` entry and the compiled executable is reused
   across the 8 Burda stages, across ``PASS_BLOCK`` dispatches, and across
   repeated ``run_experiment`` calls in one process (the driver rebuilds its
-  jitted closures per run; the registry is module-level, so the rebuild is a
-  registry hit instead of a retrace).
+  jitted closures per run; the store is module-level, so the rebuild is a
+  store hit instead of a retrace).
 
-* :func:`cache_stats` — hits / misses / compile-seconds accounting, stamped
-  into the per-stage metrics.jsonl rows by the experiment driver. "Misses" of
-  the *persistent* cache are true XLA recompiles: a warm start records zero.
+  The store is **capacity-bounded and multi-tenant** (ROADMAP item 1): each
+  entry is billed the device bytes of its static cost record (the trace-time
+  analysis stamped at compile, PR 11) and an explicit ``budget_bytes``
+  (:func:`set_store_budget`, ``IWAE_STORE_BUDGET_BYTES``, ``iwae-serve
+  --store-budget-mb``; None = unbounded, the historical behavior) caps the
+  resident set with LRU eviction. Entries pinned by an in-flight dispatch
+  are never evicted. Eviction is a **demotion, not a loss**: while the
+  persistent XLA cache is active the serialized program stays on disk (the
+  cold tier), so a re-requested entry is a fast cache-hit deserialize — a
+  *readmit* — never a fresh XLA compile. One replica can therefore serve a
+  whole model zoo under bounded device memory.
+
+* :func:`cache_stats` — hits / misses / compile-seconds plus the store's
+  eviction/demotion/readmit accounting, stamped into the per-stage
+  metrics.jsonl rows by the experiment driver. "Misses" of the *persistent*
+  cache are true XLA recompiles: a warm start — and a store readmit — records
+  zero.
+
+* the **donation gate** (:func:`donation_allowed` / :func:`donation_safe`)
+  — the ONE owner of the donation-vs-persistent-cache CPU hazard
+  (RESULTS.md §5): executable lifetime and the cache configuration both
+  live here, so the store decides whether a caller's requested donation is
+  safe to honor. Call sites (the experiment driver, the audit suite's
+  program builders) ask; they no longer compose their own guards.
 
 Resolution order for the cache directory: explicit argument (the config
 field) > ``IWAE_COMPILE_CACHE`` env > an already-configured JAX cache dir
@@ -33,11 +54,12 @@ field) > ``IWAE_COMPILE_CACHE`` env > an already-configured JAX cache dir
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import os
 import threading
 import time
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from iwae_replication_project_tpu.utils.faults import (
     SITE_AOT_CALL_ASYNC,
@@ -51,6 +73,9 @@ CACHE_SUBDIR = ".jax_compile_cache"
 
 #: spellings of "disabled" accepted from config/env
 _OFF = ("off", "none", "disabled", "0", "")
+
+#: "argument not passed" sentinel (None is a meaningful value for budgets)
+_UNSET = object()
 
 _lock = threading.Lock()
 _state = {"dir": None, "listeners_installed": False}
@@ -69,15 +94,9 @@ _counters = {
     "aot_compile_seconds": 0.0,
 }
 
-#: the AOT executable registry: signature -> jax.stages.Compiled
-_executables: dict = {}
-
-#: static cost record per registry entry (same key), stamped at compile
-#: time by the trace-only analyzer (analysis/audit/cost.py): peak HBM
-#: bytes, FLOPs, arithmetic intensity — the capacity-bounded executable
-#: store's per-entry budget inputs (ROADMAP item 1). None when tracing
-#: failed or ``IWAE_STATIC_COST=off`` disabled the stamp.
-_static_costs: dict = {}
+#: the default per-model label for callers that name no tenant (the
+#: historical single-model entry points: the experiment driver, benches)
+DEFAULT_MODEL = "default"
 
 
 # ---------------------------------------------------------------------------
@@ -192,6 +211,23 @@ def setup_persistent_cache(cache_dir: Optional[str] = None, *,
         return path
 
 
+@contextlib.contextmanager
+def suspended_persistent_cache():
+    """Temporarily disable the persistent XLA cache, restoring the prior
+    configuration on exit — the sanctioned primitive for measuring TRUE
+    fresh-compile cost (``bench.py --multi-model``'s cold-vs-readmit
+    denominator). Lives here because this module is the single owner of
+    the cache wiring (the ``cache-setup`` lint rule enforces that)."""
+    import jax
+
+    before = getattr(jax.config, "jax_compilation_cache_dir", None)
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
 def donation_safe() -> bool:
     """Whether buffer donation may be combined with the active cache setup.
 
@@ -208,17 +244,468 @@ def donation_safe() -> bool:
     persistent cache is active — on CPU there is no HBM pressure for
     donation to relieve, so the cache is strictly the better half of the
     trade.
+
+    The decision itself is owned by the executable store
+    (:meth:`ExecutableStore.donation_allowed` — executable lifetime and the
+    cache wiring live there); this module-level name is the historical
+    spelling of the unconditional ask.
     """
+    return _store.donation_allowed(True)
+
+
+def donation_allowed(requested: bool = True) -> bool:
+    """The ONE donation gate call sites use: the caller's donation request
+    (config flag, audit default) AND'd with the store-owned hazard check —
+    ``donation_allowed(cfg.donate_buffers)`` replaces the per-site
+    ``cfg.donate_buffers and donation_safe()`` composition."""
+    return _store.donation_allowed(requested)
+
+
+# ---------------------------------------------------------------------------
+# the executable store (the AOT registry, capacity-bounded + multi-tenant)
+# ---------------------------------------------------------------------------
+
+def _cold_tier_active() -> bool:
+    """Whether evicted executables have a serialized twin to fall back to:
+    the persistent XLA cache as JAX actually sees it (first-wins semantics —
+    a wrapper/conftest may have configured it without going through
+    :func:`setup_persistent_cache`, and demotion accounting must follow the
+    truth, not this module's setup record)."""
     import jax
 
-    if not getattr(jax.config, "jax_compilation_cache_dir", None):
-        return True  # no cache -> nothing deserialized -> donation is fine
-    return jax.default_backend() != "cpu"
+    return bool(getattr(jax.config, "jax_compilation_cache_dir", None))
 
 
-# ---------------------------------------------------------------------------
-# AOT executable registry
-# ---------------------------------------------------------------------------
+class _StoreEntry:
+    """One resident executable: the compiled program plus its budget bill."""
+
+    __slots__ = ("exe", "cost", "bytes", "pins", "cold")
+
+    def __init__(self, exe, cost: Optional[dict], nbytes: int, cold: bool):
+        self.exe = exe
+        #: the static cost record stamped at compile (None = stamp skipped)
+        self.cost = cost
+        #: device bytes billed against the store budget
+        self.bytes = int(nbytes)
+        #: pin refcount: > 0 means an in-flight dispatch holds the entry
+        self.pins = 0
+        #: whether a serialized twin exists in the persistent XLA cache
+        #: (compiled while the cache was active) — eviction then demotes
+        #: instead of discarding
+        self.cold = bool(cold)
+
+
+class _PrefixPin:
+    """Handle for a ``(model, name, build_key)``-prefix pin (release once)."""
+
+    __slots__ = ("_store", "_prefix", "_released")
+
+    def __init__(self, store: "ExecutableStore", prefix: Tuple):
+        self._store = store
+        self._prefix = prefix
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._store._unpin_prefix(self._prefix)
+
+
+class ExecutableStore:
+    """Capacity-bounded, multi-tenant AOT executable store.
+
+    Entries are keyed ``(model, name, build_key, signature)`` — the model
+    label names the tenant (zoo preset / checkpoint), ``name`` + ``build_key``
+    the program, and the signature the arg shapes/dtypes/shardings. Admission
+    and retention are governed by ``budget_bytes``: every entry is billed the
+    ``peak_bytes`` of its static cost record (the trace-time analyzer stamp,
+    analysis/audit/cost.py — exactly what :func:`static_cost_records`
+    surfaces), falling back to the dispatch-argument bytes when the stamp is
+    unavailable; past the budget the least-recently-used *unpinned* entries
+    are evicted until the resident set fits (an entry larger than the whole
+    budget is still admitted — refusing would refuse to serve — and evicts
+    everything else unpinned).
+
+    **Warm/cold tiers.** Residency here is the warm tier. While the
+    persistent XLA cache is active, every compiled program also has a
+    serialized twin on disk — the cold tier — so eviction *demotes*: a later
+    request for the same entry re-enters through ``lower().compile()``, which
+    collapses to a cache-hit deserialize (a *readmit*, counted; the
+    ``persistent_cache_misses`` counter stays flat — the test- and
+    smoke-pinned "evict → re-request → 0 fresh compiles" contract).
+
+    **Pins.** :meth:`pin_prefix` marks every entry under a ``(model, name,
+    build_key)`` prefix unevictable until released — the serving engines pin
+    for the lifetime of each in-flight dispatch, so a budget squeeze can
+    never pull an executable out from under work the device is running.
+
+    The store is also the process's single owner of executable lifetime,
+    which makes it the natural owner of the donation-vs-persistent-cache
+    hazard: :meth:`donation_allowed` is THE gate (RESULTS.md §5).
+    """
+
+    COUNTER_NAMES = ("hits", "misses", "evictions", "demotions", "readmits")
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        # reentrant: _evict_over_budget acquires it itself so every write
+        # is visibly guarded, and its callers already hold it
+        self._lock = threading.RLock()
+        #: key -> _StoreEntry in LRU order (last = most recently used)
+        self._entries: "collections.OrderedDict[Tuple, _StoreEntry]" = \
+            collections.OrderedDict()
+        #: evicted-while-cold-tier-available keys -> their static cost
+        #: record: a miss on one of these is a readmit (deserialize), not a
+        #: first compile — and its cost stamp is reused instead of re-traced
+        self._demoted: Dict[Tuple, Optional[dict]] = {}
+        #: active (model, name, build_key) prefix pins (refcounted)
+        self._prefix_pins: Dict[Tuple, int] = {}
+        self._budget = int(budget_bytes) if budget_bytes is not None else None
+        self._resident = 0
+        self._counters = {n: 0 for n in self.COUNTER_NAMES}
+        #: model -> {counter: n, resident_bytes implicit via entries}
+        self._per_model: Dict[str, Dict[str, int]] = {}
+        #: cached telemetry counter handles (one registry lookup per name
+        #: per process, not per dispatch)
+        self._tel_counters: Dict[str, Any] = {}
+
+    # -- accounting plumbing -------------------------------------------------
+
+    def _count(self, name: str, model: str, n: int = 1) -> None:
+        """Caller holds the lock. Mirrors every count into the process
+        telemetry registry (``store/<name>`` counters — the Prometheus
+        surface; the registry has its own lock and never calls back into
+        the store, so the store->registry lock order is acyclic)."""
+        self._counters[name] += n
+        per = self._per_model.setdefault(
+            model, {k: 0 for k in self.COUNTER_NAMES})
+        per[name] += n
+        handle = self._tel_counters.get(name)
+        if handle is None:
+            from iwae_replication_project_tpu.telemetry.registry import (
+                get_registry)
+            handle = self._tel_counters.setdefault(
+                name, get_registry().counter(f"store/{name}"))
+        handle.inc(n)
+
+    def _publish_resident(self) -> None:
+        """Caller holds the lock: export the residency gauges. An unbounded
+        budget publishes +Inf — so a dashboard comparing resident vs budget
+        reads "infinite headroom", never "permanently over a 0 budget";
+        the JSON snapshot surfaces keep the explicit None."""
+        from iwae_replication_project_tpu.telemetry.registry import (
+            get_registry)
+        reg = get_registry()
+        reg.gauge("store/resident_bytes").set(float(self._resident))
+        reg.gauge("store/budget_bytes").set(
+            float(self._budget) if self._budget is not None
+            else float("inf"))
+        reg.gauge("store/entries").set(float(len(self._entries)))
+
+    # -- budget --------------------------------------------------------------
+
+    @property
+    def budget_bytes(self) -> Optional[int]:
+        with self._lock:
+            return self._budget
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._resident
+
+    def set_budget(self, budget_bytes: Optional[int]) -> None:
+        """Set (or clear) the device-memory budget; an over-budget resident
+        set is evicted down immediately (LRU, pins respected). A negative
+        budget is a loud construction error at the ONE shared depth (CLI
+        flag and programmatic callers alike) — it would silently put the
+        store into permanent evict-everything mode; the env-var path
+        (:func:`_budget_from_env`) degrades fail-soft instead because it
+        runs at import time."""
+        if budget_bytes is not None and int(budget_bytes) < 0:
+            raise ValueError(f"store budget must be >= 0 bytes (or None "
+                             f"for unbounded), got {int(budget_bytes)}")
+        with self._lock:
+            self._budget = int(budget_bytes) \
+                if budget_bytes is not None else None
+            self._evict_over_budget()
+            self._publish_resident()
+
+    def _pinned(self, key: Tuple, entry: _StoreEntry) -> bool:
+        return entry.pins > 0 or key[:3] in self._prefix_pins
+
+    def _evict_over_budget(self) -> None:
+        """Evict LRU unpinned entries until the resident set fits the
+        budget (pinned entries are skipped — they are reconsidered at the
+        next admission/budget change after release). The lock is reentrant,
+        so callers already holding it nest cleanly."""
+        with self._lock:
+            if self._budget is None:
+                return
+            cache_active = _cold_tier_active()
+            for key in [k for k in self._entries]:  # LRU -> MRU order
+                if self._resident <= self._budget:
+                    break
+                entry = self._entries[key]
+                if self._pinned(key, entry):
+                    continue
+                del self._entries[key]
+                self._resident -= entry.bytes
+                self._count("evictions", key[0])
+                if entry.cold and cache_active:
+                    # the serialized program survives in the persistent
+                    # XLA cache: this is a demotion to the cold tier, and
+                    # the next request readmits by deserializing — never a
+                    # fresh compile
+                    self._demoted[key] = entry.cost
+                    self._count("demotions", key[0])
+
+    # -- pins ----------------------------------------------------------------
+
+    def pin_prefix(self, model: Optional[str], name: str,
+                   build_key: Tuple) -> _PrefixPin:
+        """Pin every entry (present or future) under ``(model, name,
+        build_key)`` against eviction; returns the release handle. The
+        serving engines hold one per in-flight dispatch."""
+        prefix = (model if model is not None else DEFAULT_MODEL,
+                  name, build_key)
+        with self._lock:
+            self._prefix_pins[prefix] = self._prefix_pins.get(prefix, 0) + 1
+        return _PrefixPin(self, prefix)
+
+    def _unpin_prefix(self, prefix: Tuple) -> None:
+        with self._lock:
+            n = self._prefix_pins.get(prefix, 0) - 1
+            if n <= 0:
+                self._prefix_pins.pop(prefix, None)
+            else:
+                self._prefix_pins[prefix] = n
+            # a release may unblock a DEFERRED eviction — but only when the
+            # resident set actually sits over a budget; the warm-hit fast
+            # path (every aot_call pins) must not pay an eviction scan and
+            # gauge publications for a no-op release
+            if self._budget is not None and self._resident > self._budget:
+                self._evict_over_budget()
+                self._publish_resident()
+
+    @contextlib.contextmanager
+    def pinned(self, model: Optional[str], name: str, build_key: Tuple):
+        pin = self.pin_prefix(model, name, build_key)
+        try:
+            yield
+        finally:
+            pin.release()
+
+    # -- resolution ----------------------------------------------------------
+
+    def _entry_bytes(self, cost: Optional[dict], sig) -> int:
+        """The budget bill of one entry: the static cost record's live-range
+        peak device bytes (what :func:`static_cost_records` reports — budget
+        accounting reconciles with it by construction), else the dispatch
+        argument bytes sized from the signature."""
+        if cost is not None and cost.get("peak_bytes"):
+            return int(cost["peak_bytes"])
+        return _signature_arg_bytes(sig)
+
+    def get_or_compile(self, name: str, jitted_fn: Callable, args: Tuple,
+                       kwargs: dict, static_kwargs: Optional[dict],
+                       build_key: Tuple, count_hit: bool,
+                       model: Optional[str] = None):
+        """Resolve ``(model, name, build_key, signature)`` to a compiled
+        executable, compiling (and accounting the miss) on first sight —
+        on a readmit the compile collapses to a persistent-cache
+        deserialize. ``count_hit=False`` lets warmup probes re-resolve
+        without inflating the hit counters."""
+        model = model if model is not None else DEFAULT_MODEL
+        key = (model, name, build_key,
+               _abstract_signature((args, tuple(sorted(kwargs.items(),
+                                                       key=lambda kv: kv[0]))
+                                    )))
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)      # MRU
+                if count_hit:
+                    self._count("hits", model)
+                    _counters["aot_hits"] += 1
+                return entry.exe
+            readmit = key in self._demoted
+            demoted_cost = self._demoted.get(key)
+        # miss: compile OUTSIDE the lock (seconds of XLA work — or a fast
+        # deserialize on a readmit — must not serialize other dispatches)
+        t0 = time.perf_counter()
+        lowered = jitted_fn.lower(*args, **kwargs, **(static_kwargs or {}))
+        exe = lowered.compile()
+        # compile already cost seconds; the trace-only cost stamp rides the
+        # miss (fail-soft, IWAE_STATIC_COST=off to disable) — a readmit
+        # reuses the record its demotion carried instead of re-tracing
+        cost = demoted_cost if readmit else \
+            _trace_static_cost(name, jitted_fn, args, kwargs,
+                               static_kwargs, key[3])
+        cold = _cold_tier_active()
+        with self._lock:
+            self._demoted.pop(key, None)
+            prev = self._entries.pop(key, None)     # racing double-compile
+            if prev is not None:
+                self._resident -= prev.bytes
+            entry = _StoreEntry(exe, cost, self._entry_bytes(cost, key[3]),
+                                cold)
+            self._entries[key] = entry
+            self._resident += entry.bytes
+            self._count("misses", model)
+            if readmit:
+                self._count("readmits", model)
+            _counters["aot_misses"] += 1
+            _counters["aot_compile_seconds"] += time.perf_counter() - t0
+            self._evict_over_budget()
+            self._publish_resident()
+        return exe
+
+    # -- introspection -------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def keys(self) -> List[Tuple]:
+        """Entry keys, LRU -> MRU order (tests pin eviction order on it)."""
+        with self._lock:
+            return list(self._entries)
+
+    def entries(self) -> List[dict]:
+        """Resident-entry snapshot, LRU -> MRU: model/name/bytes/pins/cold
+        per entry (the ``iwae-serve`` stats surface and the tests')."""
+        with self._lock:
+            return [{"model": key[0], "name": key[1], "bytes": e.bytes,
+                     "pinned": self._pinned(key, e), "cold": e.cold}
+                    for key, e in self._entries.items()]
+
+    def scalar_stats(self) -> dict:
+        """Counters + residency scalars only — no per-model aggregation
+        (which walks every entry) — for :func:`cache_stats`, which the
+        serving engine diffs TWICE per dispatched batch."""
+        with self._lock:
+            return {**self._counters,
+                    "resident_bytes": self._resident,
+                    "budget_bytes": self._budget,
+                    "entries": len(self._entries)}
+
+    def stats(self) -> dict:
+        """Counters + residency, overall and per model."""
+        with self._lock:
+            per_model: Dict[str, dict] = {
+                m: dict(c) for m, c in self._per_model.items()}
+            for key, e in self._entries.items():
+                per = per_model.setdefault(
+                    key[0], {k: 0 for k in self.COUNTER_NAMES})
+                per["resident_bytes"] = per.get("resident_bytes", 0) + e.bytes
+                per["entries"] = per.get("entries", 0) + 1
+            for per in per_model.values():
+                per.setdefault("resident_bytes", 0)
+                per.setdefault("entries", 0)
+            return {**{k: v for k, v in self._counters.items()},
+                    "resident_bytes": self._resident,
+                    "budget_bytes": self._budget,
+                    "entries": len(self._entries),
+                    "demoted": len(self._demoted),
+                    "per_model": per_model}
+
+    def signatures(self) -> List[Tuple]:
+        """``(name, build_key, signature)`` per entry (the audit surface —
+        see :func:`registry_signatures`)."""
+        with self._lock:
+            return [(name, build_key, sig)
+                    for (_model, name, build_key, sig) in self._entries]
+
+    def cost_records(self) -> List[Tuple]:
+        """``(name, build_key, signature, static_cost | None)`` per entry
+        (see :func:`static_cost_records`)."""
+        with self._lock:
+            return [(key[1], key[2], key[3], e.cost)
+                    for key, e in self._entries.items()]
+
+    # -- donation gate -------------------------------------------------------
+
+    def donation_allowed(self, requested: bool = True) -> bool:
+        """THE donation-vs-cache gate: whether a caller's requested buffer
+        donation may be honored under the active cache setup. The store owns
+        executable lifetime AND the persistent-cache wiring, so this is the
+        one place the jaxlib-0.4.x XLA:CPU hazard (donation + cache-
+        deserialized executables corrupt memory — RESULTS.md §5) is decided;
+        call sites pass their request instead of composing their own guard.
+        """
+        import jax
+
+        if not requested:
+            return False
+        if not getattr(jax.config, "jax_compilation_cache_dir", None):
+            return True  # no cache -> nothing deserialized -> donation fine
+        return jax.default_backend() != "cpu"
+
+    # -- state swap (test isolation) -----------------------------------------
+
+    def _swap_state(self, entries=None, demoted=None, budget=_UNSET):
+        """Replace entries/demoted (and optionally the budget), returning
+        the previous triple — :func:`isolated_aot_registry`'s mechanism."""
+        with self._lock:
+            prev = (self._entries, self._demoted, self._budget)
+            self._entries = entries if entries is not None \
+                else collections.OrderedDict()
+            self._demoted = demoted if demoted is not None else {}
+            if budget is not _UNSET:
+                self._budget = budget
+            self._resident = sum(e.bytes for e in self._entries.values())
+            self._evict_over_budget()
+            self._publish_resident()
+            return prev
+
+
+def _budget_from_env() -> Optional[int]:
+    """``IWAE_STORE_BUDGET_BYTES`` as the default store's budget. Fail-soft
+    by design: this runs at import time, and a typo in an env var must
+    degrade LOUDLY to the unbounded default rather than make the whole
+    package unimportable."""
+    raw = (os.environ.get("IWAE_STORE_BUDGET_BYTES") or "").strip()
+    if raw.lower() in _OFF:
+        return None
+    try:
+        value = int(float(raw))
+    except ValueError:
+        import warnings
+
+        warnings.warn(f"IWAE_STORE_BUDGET_BYTES={raw!r} is not a number; "
+                      f"executable-store budget left UNBOUNDED")
+        return None
+    if value < 0:
+        import warnings
+
+        warnings.warn(f"IWAE_STORE_BUDGET_BYTES={value} is negative; "
+                      f"executable-store budget left UNBOUNDED")
+        return None
+    return value
+
+
+#: the process-default store every module-level helper routes through
+_store = ExecutableStore(budget_bytes=_budget_from_env())
+
+
+def executable_store() -> ExecutableStore:
+    """The process-default :class:`ExecutableStore` (the module-level AOT
+    helpers' backing store)."""
+    return _store
+
+
+def set_store_budget(budget_bytes: Optional[int]) -> None:
+    """Set the default store's device-memory budget (None = unbounded);
+    evicts immediately when the resident set exceeds it."""
+    _store.set_budget(budget_bytes)
+
+
+def store_stats() -> dict:
+    """The default store's counter/residency snapshot (overall + per
+    model) — what ``ServingMetrics.snapshot()['store']`` and the
+    multi-model bench/smoke read."""
+    return _store.stats()
+
 
 def _abstract_signature(args: Tuple) -> Tuple:
     """Hashable (treedef, per-leaf shape/dtype/sharding/weak) fingerprint of
@@ -249,30 +736,29 @@ def _abstract_signature(args: Tuple) -> Tuple:
 
 
 def registry_signatures() -> list:
-    """``(name, build_key, signature)`` for every registered executable.
+    """``(name, build_key, signature)`` for every resident executable.
 
     The audit CLI's recompile-cardinality pass walks these to flag python-
     scalar and weak-typed signature leaves — each of which mints one
-    executable per distinct value and fragments this registry under serving
-    traffic.
+    executable per distinct value and fragments the store under serving
+    traffic. (The model label is deliberately absent: program fragmentation
+    is per program, not per tenant.)
     """
-    with _lock:
-        return [(name, build_key, sig)
-                for (name, build_key, sig) in _executables]
+    return _store.signatures()
 
 
 def static_cost_records() -> list:
-    """``(name, build_key, signature, static_cost | None)`` per executable.
+    """``(name, build_key, signature, static_cost | None)`` per resident
+    executable.
 
     ``static_cost`` is the trace-time cost record (peak HBM bytes, FLOPs,
     arithmetic intensity, per-axis collective counts, plus ``arg_bytes``
-    sized from the dispatch signature itself) — what a capacity-bounded
-    executable store budgets its LRU eviction with, and what ``iwae-cost
-    --registry`` surfaces. Entries stamped None mean the fail-soft trace
-    was skipped (``IWAE_STATIC_COST=off``) or failed.
+    sized from the dispatch signature itself) — exactly what the store
+    budgets its LRU eviction with (``peak_bytes``, arg-bytes fallback), and
+    what ``iwae-cost --registry`` surfaces. Entries stamped None mean the
+    fail-soft trace was skipped (``IWAE_STATIC_COST=off``) or failed.
     """
-    with _lock:
-        return [(*key, _static_costs.get(key)) for key in _executables]
+    return _store.cost_records()
 
 
 def _signature_arg_bytes(sig) -> int:
@@ -292,7 +778,10 @@ def _signature_arg_bytes(sig) -> int:
             try:
                 total += int(math.prod(shape)) * byte_width(dtype)
             except ValueError:
-                pass  # an exotic dtype string: skip, never crash dispatch
+                # an exotic dtype string outside the shared byte-width
+                # table: skip the leaf from the estimate, never crash
+                # dispatch over an accounting detail
+                continue
     return total
 
 
@@ -329,40 +818,11 @@ def _trace_static_cost(name: str, jitted_fn: Callable, args: Tuple,
         return None
 
 
-def _registry_get_or_compile(name: str, jitted_fn: Callable, args: Tuple,
-                             kwargs: dict, static_kwargs: Optional[dict],
-                             build_key: Tuple, count_hit: bool):
-    """Resolve ``(name, build_key, signature)`` to a compiled executable,
-    compiling (and accounting the miss) on first sight. `count_hit=False`
-    lets warmup probes re-resolve without inflating the hit counters."""
-    key = (name, build_key,
-           _abstract_signature((args, tuple(sorted(kwargs.items(),
-                                                   key=lambda kv: kv[0])))))
-    exe = _executables.get(key)
-    if exe is None:
-        t0 = time.perf_counter()
-        lowered = jitted_fn.lower(*args, **kwargs, **(static_kwargs or {}))
-        exe = lowered.compile()
-        # compile already cost seconds; the trace-only cost stamp rides the
-        # miss (fail-soft, IWAE_STATIC_COST=off to disable)
-        cost = _trace_static_cost(name, jitted_fn, args, kwargs,
-                                  static_kwargs, key[2])
-        with _lock:
-            _executables[key] = exe
-            if cost is not None:
-                _static_costs[key] = cost
-            _counters["aot_misses"] += 1
-            _counters["aot_compile_seconds"] += time.perf_counter() - t0
-    elif count_hit:
-        with _lock:
-            _counters["aot_hits"] += 1
-    return exe
-
-
 def aot_call_async(name: str, jitted_fn: Callable, args: Tuple = (),
                    kwargs: Optional[dict] = None,
                    static_kwargs: Optional[dict] = None,
-                   build_key: Tuple = ()) -> Any:
+                   build_key: Tuple = (),
+                   model: Optional[str] = None) -> Any:
     """Enqueue ``jitted_fn(*args, **kwargs, **static_kwargs)`` via the
     registry and return the resulting **device arrays without any host
     synchronization** — the explicitly-async AOT call path.
@@ -383,28 +843,36 @@ def aot_call_async(name: str, jitted_fn: Callable, args: Tuple = (),
     compiled executable (a *hit*) with zero tracing or cache-key hashing of
     the jaxpr. ``build_key`` must capture everything the caller baked into
     the closure (objective spec, model config, n_train, donation, mesh, ...):
-    two distinct programs must never share a registry slot.
+    two distinct programs must never share a store slot. ``model`` labels the
+    tenant (zoo preset / checkpoint) the entry belongs to — the store's
+    per-model accounting and eviction attribution; None = the single-model
+    default label. The entry is pinned against eviction for the duration of
+    the resolve + enqueue.
     """
     kwargs = kwargs or {}
-    exe = _registry_get_or_compile(name, jitted_fn, args, kwargs,
-                                   static_kwargs, build_key, count_hit=True)
-    # chaos hook (utils/faults.py): every AOT dispatch passes this point,
-    # so an injected raise here models the enqueue-time failure class
-    # (OOM, poisoned runtime) for ANY program; off = one None check
-    fault_point(SITE_AOT_CALL_ASYNC, name=name)
-    # every AOT dispatch in the process funnels through here — the ONE span
-    # site that covers training epochs, the fused eval suite, and serving
-    # alike (the time recorded is enqueue, not device completion: async
-    # dispatch returns as soon as the transfer program is queued)
-    from iwae_replication_project_tpu.telemetry.spans import span
-    with span(f"aot/{name}"):
-        return exe(*args, **kwargs)
+    with _store.pinned(model, name, build_key):
+        exe = _store.get_or_compile(name, jitted_fn, args, kwargs,
+                                    static_kwargs, build_key, count_hit=True,  # iwaelint: disable=key-reuse -- build_key is a program-identity tuple, not a PRNG key: handing it to both the pin and the resolver is the contract, no randomness is consumed
+                                    model=model)
+        # chaos hook (utils/faults.py): every AOT dispatch passes this point,
+        # so an injected raise here models the enqueue-time failure class
+        # (OOM, poisoned runtime) for ANY program; off = one None check
+        fault_point(SITE_AOT_CALL_ASYNC, name=name)
+        # every AOT dispatch in the process funnels through here — the ONE
+        # span site that covers training epochs, the fused eval suite, and
+        # serving alike (the time recorded is enqueue, not device
+        # completion: async dispatch returns as soon as the transfer
+        # program is queued)
+        from iwae_replication_project_tpu.telemetry.spans import span
+        with span(f"aot/{name}"):
+            return exe(*args, **kwargs)
 
 
 def aot_call(name: str, jitted_fn: Callable, args: Tuple = (),
              kwargs: Optional[dict] = None,
              static_kwargs: Optional[dict] = None,
-             build_key: Tuple = ()) -> Any:
+             build_key: Tuple = (),
+             model: Optional[str] = None) -> Any:
     """Call ``jitted_fn(*args, **kwargs, **static_kwargs)`` via the registry.
 
     The historical name for :func:`aot_call_async` — JAX dispatch has always
@@ -418,67 +886,68 @@ def aot_call(name: str, jitted_fn: Callable, args: Tuple = (),
     program — pass statics that interleave positionally by keyword).
     """
     return aot_call_async(name, jitted_fn, args, kwargs=kwargs,
-                          static_kwargs=static_kwargs, build_key=build_key)
+                          static_kwargs=static_kwargs, build_key=build_key,
+                          model=model)
 
 
 def aot_warm(name: str, jitted_fn: Callable, args: Tuple = (),
              kwargs: Optional[dict] = None,
              static_kwargs: Optional[dict] = None,
-             build_key: Tuple = ()) -> Any:
-    """Populate the registry for this call signature WITHOUT executing.
+             build_key: Tuple = (),
+             model: Optional[str] = None) -> Any:
+    """Populate the store for this call signature WITHOUT executing.
 
     The bucket-warmup API for online serving (serving/engine.py): an engine
     pre-compiles one executable per (op, shape bucket, k, dtype) ladder rung
     at startup, so the first live request of every bucket is already a
-    registry hit — no compile storm under ragged traffic. Returns the
+    store hit — no compile storm under ragged traffic. Returns the
     executable. A signature already present is a no-op (and is NOT counted
     as an aot hit: warmup probes must not skew the serving hit-rate metric).
     """
-    return _registry_get_or_compile(name, jitted_fn, args, kwargs or {},
-                                    static_kwargs, build_key, count_hit=False)
+    return _store.get_or_compile(name, jitted_fn, args, kwargs or {},
+                                 static_kwargs, build_key, count_hit=False,
+                                 model=model)
 
 
 def warm_callable(name: str, jitted_fn: Callable,
-                  build_key: Tuple = ()) -> Callable:
+                  build_key: Tuple = (),
+                  model: Optional[str] = None) -> Callable:
     """Wrap a jitted function so every call routes through :func:`aot_call`.
 
     Drop-in for the driver's epoch/step functions: same call signature, same
     results, but the compiled executable is shared process-wide per
-    ``(name, build_key, arg signature)`` — across stages, across
+    ``(model, name, build_key, arg signature)`` — across stages, across
     ``PASS_BLOCK`` blocks, and across `run_experiment` invocations.
     """
     def call(*args):
-        return aot_call(name, jitted_fn, args, build_key=build_key)
+        return aot_call(name, jitted_fn, args, build_key=build_key,
+                        model=model)
 
     call.__name__ = f"warm_{name}"
     return call
 
 
 @contextlib.contextmanager
-def isolated_aot_registry():
-    """Run with an EMPTY AOT executable registry; restore the previous one
-    (dropping entries created inside) on exit.
+def isolated_aot_registry(budget_bytes=_UNSET):
+    """Run with an EMPTY executable store; restore the previous contents
+    (dropping entries created inside) on exit. ``budget_bytes`` optionally
+    sets a store budget for the duration (the multi-model bench/tests
+    exercise eviction this way without disturbing the process default).
 
-    For tests that compare two driver runs: the registry is process-global
+    For tests that compare two driver runs: the store is process-global
     and keyed by build signature only, so a run inside a test can silently
     reuse an executable an earlier test compiled under different cache /
     donation conditions — making the two compared runs asymmetric (one fresh
     compile, one reuse). Isolation restores the symmetry the comparison
     assumes.
     """
-    with _lock:
-        saved = dict(_executables)
-        saved_costs = dict(_static_costs)
-        _executables.clear()
-        _static_costs.clear()
+    prev_entries, prev_demoted, prev_budget = _store._swap_state(
+        budget=budget_bytes)
     try:
         yield
     finally:
-        with _lock:
-            _executables.clear()
-            _executables.update(saved)
-            _static_costs.clear()
-            _static_costs.update(saved_costs)
+        _store._swap_state(entries=prev_entries, demoted=prev_demoted,
+                           budget=prev_budget)
 
 
 # ---------------------------------------------------------------------------
@@ -489,15 +958,23 @@ def cache_stats() -> dict:
     """Snapshot of the process-global warm-path counters.
 
     ``persistent_cache_misses`` counts true XLA backend compiles whose result
-    was not in the on-disk cache — the number a warm start must hold at zero.
-    ``aot_*`` count the executable-registry behavior; ``backend_compile_
-    seconds`` is total time inside XLA's compile entry point (on a warm start
-    it collapses to cache-deserialization time).
+    was not in the on-disk cache — the number a warm start (and a store
+    readmit) must hold at zero. ``aot_*`` count the executable-store
+    behavior; ``backend_compile_seconds`` is total time inside XLA's compile
+    entry point (on a warm start it collapses to cache-deserialization
+    time). ``store_*`` are the capacity-bound counters: evictions under the
+    budget, demotions to the persistent-cache cold tier, and readmits
+    (deserializing re-entries of demoted programs).
     """
     with _lock:
         snap = dict(_counters)
     snap["cache_dir"] = _state["dir"]
-    snap["aot_executables"] = len(_executables)
+    st = _store.scalar_stats()
+    snap["aot_executables"] = st["entries"]
+    for name in ExecutableStore.COUNTER_NAMES:
+        snap[f"store_{name}"] = st[name]
+    snap["store_resident_bytes"] = st["resident_bytes"]
+    snap["store_budget_bytes"] = st["budget_bytes"]
     return snap
 
 

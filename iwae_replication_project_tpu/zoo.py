@@ -145,6 +145,37 @@ def serving_engine(config_or_name, *, checkpoint_dir: str = None,
                          k=cfg.k if k is None else k, **knobs)
 
 
+def serving_engines(names, *, replicas_per_model: int = 1, k: int = None,
+                    checkpoint_dirs: Dict[str, str] = None, **knobs):
+    """Multi-model replica set from a zoo manifest: one (or
+    ``replicas_per_model``) model-labeled :class:`~.serving.ServingEngine`
+    per preset name, ready to hand a :class:`~.serving.frontend.ServingTier`
+    — the ``iwae-serve --models`` construction path.
+
+    Every engine is labeled ``model=<name>``, so its executables land under
+    that tenant in the process executable store (capacity-bounded,
+    utils/compile_cache.py), its latency histograms carry the model label,
+    and the tier's router classifies ``model``-tagged requests onto it.
+    Replicas of the same model share one set of weights (initialized once).
+    ``checkpoint_dirs`` optionally maps preset names to experiment run
+    directories (trained weights); unmapped names serve fresh inits, which
+    is what load tests and benches want.
+    """
+    engines = []
+    for name in names:
+        get(name)                   # unknown preset fails loudly up front
+        ckpt = (checkpoint_dirs or {}).get(name)
+        first = serving_engine(name, checkpoint_dir=ckpt, k=k,
+                               model=name, **knobs)
+        engines.append(first)
+        from iwae_replication_project_tpu.serving.engine import ServingEngine
+        for _ in range(1, max(1, int(replicas_per_model))):
+            engines.append(ServingEngine(
+                params=first._params, model_config=first.cfg, k=first.k,
+                k_max=first.k_max, model=name, **knobs))
+    return engines
+
+
 def get(name: str) -> ExperimentConfig:
     zoo = configs()
     if name not in zoo:

@@ -36,7 +36,13 @@ machine-readable summary.
    replica beaten by a client hedge, SIGTERM-mid-stage + resume and
    truncated-checkpoint fallback both bitwise-identical to an
    uninterrupted run; summary committed to ``results/chaos_smoke.json``;
-11. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
+11. **multi-model smoke** (scripts/multi_model_smoke.py) — a two-model zoo
+   behind one tier over a real socket with the executable-store budget
+   squeezed to one model's worth: forced eviction churn mid-burst, every
+   response bitwise-correct vs dedicated single-model engines, zero
+   fresh compiles once warm (evictions demote to the persistent cache
+   and readmit by deserialization);
+12. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
    ``--sanitize`` armed.
 
 Every full-gate run writes ``results/check_summary.json`` (per-stage status,
@@ -200,6 +206,12 @@ def run_chaos_smoke() -> dict:
                                                   "chaos_smoke.py")])
 
 
+def run_multi_model_smoke() -> dict:
+    return run_step("multi-model smoke",
+                    [sys.executable, os.path.join("scripts",
+                                                  "multi_model_smoke.py")])
+
+
 def run_tests(extra) -> dict:
     return run_step("tier-1 tests", [
         sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
@@ -245,6 +257,7 @@ def main(argv=None) -> int:
         stages.append(run_hot_loop_smoke())
         stages.append(run_autotune_smoke())
         stages.append(run_chaos_smoke())
+        stages.append(run_multi_model_smoke())
     if not args.lint_only:
         stages.append(run_tests(passthrough))
 

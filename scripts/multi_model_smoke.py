@@ -1,0 +1,162 @@
+"""Multi-model serving smoke stage for scripts/check.py (ISSUE 13).
+
+One short CPU process that proves the multi-tenant executable store's two
+hard invariants with REAL engines, a REAL socket client, and a two-model
+zoo behind one tier:
+
+1. **bitwise-correct under churn** — a burst alternating between two
+   model-labeled replicas, with the store budget squeezed to fit roughly
+   ONE model's executables, so every model switch forces LRU
+   eviction/readmission mid-burst: every request is still answered ``ok``
+   and every result bitwise-matches a dedicated single-model engine run
+   of the same (payload, seed, k) — eviction is invisible to results;
+
+2. **0 fresh compiles once warm** — after :meth:`ServingTier.warmup`
+   populated the warm store AND the persistent XLA cache (the cold tier),
+   the whole churning burst performs ZERO fresh XLA compiles
+   (``persistent_cache_misses`` stays flat): an evicted program re-enters
+   by deserialization (``store_readmits`` > 0), never by compilation.
+
+Uses the same deliberately tiny architectures as serving_smoke.py (two
+DIFFERENT shapes, so the tenants are genuinely distinct programs): this
+checks store/fleet plumbing, not throughput — ``bench.py --multi-model``
+owns the numbers.
+
+Exit 0 on success, 1 with a message on the first failed check.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        setup_persistent_cache)
+
+    # warm-path discipline, like every entry point — AND the smoke's cold
+    # tier: demoted executables readmit from this cache
+    setup_persistent_cache(base_dir=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+    import numpy as np
+
+    from iwae_replication_project_tpu.models import iwae as model
+    from iwae_replication_project_tpu.serving import ServingEngine
+    from iwae_replication_project_tpu.serving.frontend import (
+        ServingTier, TierClient)
+    from iwae_replication_project_tpu.utils import compile_cache as cc
+
+    D = 24
+    cfgs = {
+        # two genuinely different architectures: distinct programs, so the
+        # store holds distinct per-tenant entries (a shared-arch zoo would
+        # still key per model — this makes the byte accounting visible)
+        "zoo-a": model.ModelConfig(x_dim=D, n_hidden_enc=(16,),
+                                   n_latent_enc=(6,), n_hidden_dec=(16,),
+                                   n_latent_dec=(D,)),
+        "zoo-b": model.ModelConfig(x_dim=D, n_hidden_enc=(12, 8),
+                                   n_latent_enc=(8, 4),
+                                   n_hidden_dec=(8, 12),
+                                   n_latent_dec=(8, D)),
+    }
+    params = {name: model.init_params(jax.random.PRNGKey(i), cfg)
+              for i, (name, cfg) in enumerate(cfgs.items())}
+
+    def engine(name, label):
+        return ServingEngine(params=params[name], model_config=cfgs[name],
+                             k=4, max_batch=4, max_inflight=2,
+                             timeout_s=30.0, model=label)
+
+    rng = np.random.RandomState(0)
+    n_requests = 24
+    rows = (rng.rand(n_requests, D) > 0.5).astype(np.float32)
+    models = [("zoo-a" if i % 2 == 0 else "zoo-b")
+              for i in range(n_requests)]
+
+    # ---- reference: dedicated single-model engines, same (row, seed, k)
+    # (results are a pure function of (weights, payload, seed, k), so the
+    # dedicated engines are the oracle the churning tier must bit-match)
+    ref = {}
+    with cc.isolated_aot_registry():
+        direct = {name: engine(name, label=None) for name in cfgs}
+        futs = [direct[models[i]].submit("score", rows[i], seed=i)
+                for i in range(n_requests)]
+        for e in direct.values():
+            e.flush()
+        ref = {i: float(f.result()) for i, f in enumerate(futs)}
+
+    # ---- the two-model tier behind one socket
+    tier = ServingTier([engine("zoo-a", "zoo-a"), engine("zoo-b", "zoo-b")],
+                       port=0)
+    warm = tier.warmup(ops=("score",))
+    assert warm["programs"] > 0, warm
+
+    # squeeze the budget to ~one model's worth so every tenant switch in
+    # the burst churns the store (evict + readmit)
+    st = cc.store_stats()
+    per_model = {m: d["resident_bytes"]
+                 for m, d in st["per_model"].items() if d["entries"] > 0}
+    assert set(per_model) >= {"zoo-a", "zoo-b"}, per_model
+    budget = max(per_model["zoo-a"], per_model["zoo-b"]) + 1
+    cc.set_store_budget(budget)
+
+    tier.start()
+    s0 = cc.cache_stats()
+
+    # alternating single-row burst (explicit seeds: the parity hook) over
+    # a real socket; pipelined so both engines hold work concurrently
+    with TierClient("127.0.0.1", tier.port) as cli:
+        ids = [cli.submit("score", rows[i].tolist(), seed=i,
+                          model=models[i])
+               for i in range(n_requests)]
+        responses = cli.drain(ids)
+        stats = cli.stats()
+
+    d = cc.stats_delta(s0)
+    cc.set_store_budget(None)       # restore before any assert can bail
+    tier.stop(timeout_s=30)
+
+    # every request answered ok, every result bitwise == dedicated engine
+    bad = [responses[rid] for rid in ids if not responses[rid]["ok"]]
+    assert not bad, f"requests failed under store churn: {bad[:2]}"
+    for i, rid in enumerate(ids):
+        got = float(responses[rid]["result"][0])
+        assert got == ref[i], \
+            (f"row {i} ({models[i]}) differs from the dedicated engine "
+             f"under churn: {got!r} != {ref[i]!r}")
+
+    # the churn really happened: the budget forced evictions and the
+    # evicted programs came back as readmits (demotion -> cold tier)
+    assert d["store_evictions"] > 0, f"no eviction churn: {d}"
+    assert d["store_readmits"] > 0, f"no readmissions: {d}"
+    assert d["store_demotions"] > 0, f"no demotions: {d}"
+
+    # ...and NONE of it compiled anything fresh: the whole burst, churn
+    # included, is served from the warm store + the persistent cold tier
+    assert d["persistent_cache_misses"] == 0, \
+        f"store churn caused fresh XLA compiles: {d}"
+
+    # the wire stats doc carries the same store accounting
+    ws = stats["store"]
+    assert ws["budget_bytes"] == budget, ws
+    assert set(ws["per_model"]) >= {"zoo-a", "zoo-b"}, ws
+
+    print(f"multi-model smoke OK: {n_requests} requests over TCP across "
+          f"2 models under a {budget}-byte budget — "
+          f"{d['store_evictions']} evictions / {d['store_readmits']} "
+          f"readmits mid-burst, 0 fresh compiles, bitwise == dedicated "
+          f"single-model engines")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"multi-model smoke FAILED: {e}", file=sys.stderr)
+        sys.exit(1)

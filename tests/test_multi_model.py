@@ -1,0 +1,496 @@
+"""Multi-tenant executable store + model-aware serving stack (ISSUE 13).
+
+Four layers, mirroring the PR's ownership chain:
+
+* **store** (utils/compile_cache.ExecutableStore) — LRU eviction order
+  under an explicit byte budget, pin-during-dispatch protection, budget
+  accounting reconciling bit-exactly with ``static_cost_records()``, and
+  the warm/cold tier contract: evict -> re-request -> a *readmit* with
+  ZERO fresh XLA compiles (``persistent_cache_misses`` stays flat);
+* **engine** — the ``model`` label keys store entries per tenant and an
+  unknown model at ``submit`` is the typed ``bad_request`` (ValueError);
+* **router/wire** — model capability snapshots, model-affinity routing
+  (fake engines, no device), default-model resolution in an all-labeled
+  fleet, unknown-model rejection at the router AND over a live socket
+  (typed response, connection survives), per-(client, model) quotas;
+* **RemoteEngine** — a multi-model child tier's capability set rides the
+  info handshake into a parent router, and ``model`` rides the wire.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu.utils import compile_cache as cc
+from iwae_replication_project_tpu.utils.compile_cache import ExecutableStore
+
+
+def _program(scale):
+    """One tiny distinct jitted program per scale (distinct jaxprs, so
+    distinct store entries with distinct persistent-cache keys)."""
+    @jax.jit
+    def f(x):
+        return jnp.tanh(x * float(scale)).sum()
+
+    return f
+
+
+def _fill(store_models, x=None):
+    """Admit one program per (name, model) via the module-level API (the
+    store under test is the process default, isolated by the caller)."""
+    x = x if x is not None else jnp.ones((16, 16))
+    for i, (name, model) in enumerate(store_models):
+        cc.aot_call(name, _program(i + 1), (x,), model=model)
+
+
+# ---------------------------------------------------------------------------
+# store: LRU / pins / budget / warm-cold tiers
+# ---------------------------------------------------------------------------
+
+class TestExecutableStore:
+    def test_lru_eviction_order(self):
+        """Under budget pressure the LEAST recently used entry goes first;
+        a hit refreshes recency."""
+        with cc.isolated_aot_registry(budget_bytes=None):
+            s0 = cc.cache_stats()
+            _fill([("p0", "a"), ("p1", "b"), ("p2", "c")])
+            store = cc.executable_store()
+            b0 = store.stats()["per_model"].get(
+                "b", {}).get("evictions", 0)
+            per = store.stats()["resident_bytes"] // 3
+            # touch p0 -> MRU order is now p1, p2, p0
+            cc.aot_call("p0", _program(1), (jnp.ones((16, 16)),), model="a")
+            store.set_budget(2 * per + per // 2)     # fits two of three
+            names = [k[1] for k in store.keys()]
+            assert names == ["p2", "p0"], \
+                f"LRU (p1) should have been evicted first, kept {names}"
+            d = cc.stats_delta(s0)
+            assert d["store_evictions"] == 1
+            assert store.stats()["per_model"]["b"]["evictions"] == b0 + 1
+
+    def test_pinned_entry_never_evicted_mid_dispatch(self):
+        """A pinned entry survives any budget squeeze; release makes it
+        evictable again (the engine pins for each in-flight dispatch)."""
+        with cc.isolated_aot_registry(budget_bytes=None):
+            store = cc.executable_store()
+            _fill([("p0", "a")])
+            pin = store.pin_prefix("a", "p0", ())
+            _fill([("p1", "b")])
+            per = store.stats()["resident_bytes"] // 2
+            store.set_budget(per // 2)       # fits NOTHING unpinned
+            assert [k[1] for k in store.keys()] == ["p0"], \
+                "pinned entry was evicted mid-dispatch"
+            pin.release()                    # release triggers re-eviction
+            assert store.keys() == [], "released entry not reclaimed"
+
+    def test_budget_accounting_reconciles_with_static_cost_records(self):
+        """Every entry's budget bill is exactly its static cost record's
+        ``peak_bytes`` (arg-bytes fallback when the stamp is off), so the
+        store's resident_bytes is the sum over static_cost_records()."""
+        with cc.isolated_aot_registry(budget_bytes=None):
+            _fill([("p0", "a"), ("p1", "a"), ("p2", "b")])
+            expected = 0
+            for name, build_key, sig, cost in cc.static_cost_records():
+                if cost is not None and cost.get("peak_bytes"):
+                    expected += int(cost["peak_bytes"])
+                else:
+                    expected += cc._signature_arg_bytes(sig)
+            st = cc.store_stats()
+            assert st["resident_bytes"] == expected
+            # per-model residency sums to the same total (counters are
+            # process-global/monotonic, so restrict to live entries)
+            resident = {m: d["resident_bytes"]
+                        for m, d in st["per_model"].items()
+                        if d["entries"] > 0}
+            assert sum(resident.values()) == expected
+            assert set(resident) == {"a", "b"}
+
+    def test_evict_readmit_zero_fresh_compiles(self):
+        """The acceptance pin: evict -> re-request -> the entry READMITS
+        (counted) with zero fresh XLA compiles — the compile collapses to
+        the warm layers under the store (persistent/in-memory cache)."""
+        with cc.isolated_aot_registry(budget_bytes=None):
+            store = cc.executable_store()
+            x = jnp.ones((16, 16))
+            s_pre = cc.cache_stats()
+            _fill([("p0", "a"), ("p1", "b")], x=x)
+            ref = float(cc.aot_call("p0", _program(1), (x,), model="a"))
+            per = store.stats()["resident_bytes"] // 2
+            store.set_budget(per + per // 2)         # fits one of the two
+            d_evict = cc.stats_delta(s_pre)
+            assert d_evict["store_evictions"] == 1
+            assert d_evict["store_demotions"] == 1
+            assert [k[1] for k in store.keys()] == ["p0"]
+            s0 = cc.cache_stats()
+            out = float(cc.aot_call("p1", _program(2), (x,), model="b"))
+            d = cc.stats_delta(s0)
+            assert d["persistent_cache_misses"] == 0, \
+                f"readmit was a fresh XLA compile: {d}"
+            assert d["store_readmits"] == 1 and d["store_misses"] == 1
+            # and the readmitted program computes the same bits
+            assert out == float(_program(2)(x))
+            assert ref == float(_program(1)(x))
+
+    def test_oversized_entry_still_admitted(self):
+        """An entry larger than the whole budget is admitted (refusing
+        would refuse to serve) and everything else unpinned is evicted."""
+        with cc.isolated_aot_registry(budget_bytes=1):
+            _fill([("p0", "a")])
+            st = cc.store_stats()
+            assert st["entries"] in (0, 1)   # admitted, then LRU-evictable
+            # the call itself succeeded and returned a real result — the
+            # budget never refuses service
+            out = cc.aot_call("p0", _program(1), (jnp.ones((16, 16)),),
+                              model="a")
+            assert np.isfinite(float(out))
+
+    def test_store_counters_exported_in_cache_stats(self):
+        with cc.isolated_aot_registry(budget_bytes=None):
+            s0 = cc.cache_stats()
+            for key in ("store_hits", "store_misses", "store_evictions",
+                        "store_demotions", "store_readmits",
+                        "store_resident_bytes", "store_budget_bytes"):
+                assert key in s0, key
+            _fill([("p0", "a")])
+            cc.aot_call("p0", _program(1), (jnp.ones((16, 16)),), model="a")
+            d = cc.stats_delta(s0)
+            assert d["store_misses"] == 1 and d["store_hits"] == 1
+
+    def test_isolated_registry_budget_restored(self):
+        before = cc.executable_store().budget_bytes
+        with cc.isolated_aot_registry(budget_bytes=12345):
+            assert cc.executable_store().budget_bytes == 12345
+        assert cc.executable_store().budget_bytes == before
+
+
+# ---------------------------------------------------------------------------
+# engine boundary
+# ---------------------------------------------------------------------------
+
+def _tiny_engine(model=None, **kw):
+    from iwae_replication_project_tpu.models import iwae as m
+    from iwae_replication_project_tpu.serving import ServingEngine
+
+    D = 16
+    cfg = m.ModelConfig(x_dim=D, n_hidden_enc=(8,), n_latent_enc=(4,),
+                        n_hidden_dec=(8,), n_latent_dec=(D,))
+    params = m.init_params(jax.random.PRNGKey(0), cfg)
+    return ServingEngine(params=params, model_config=cfg, k=3, max_batch=4,
+                         model=model, **kw)
+
+
+class TestEngineModelBoundary:
+    def test_unknown_model_typed_bad_request(self):
+        eng = _tiny_engine(model="m-a")
+        with pytest.raises(ValueError, match="unknown model"):
+            eng.submit("score", [0.0] * 16, model="m-b")
+        # and nothing was enqueued: the reject is synchronous
+        assert eng.metrics.snapshot()["counters"]["submitted"] == 0
+
+    def test_unlabeled_engine_rejects_named_model(self):
+        eng = _tiny_engine(model=None)
+        with pytest.raises(ValueError, match="no named models"):
+            eng.submit("score", [0.0] * 16, model="m-a")
+
+    def test_own_model_accepted_and_store_entries_labeled(self):
+        with cc.isolated_aot_registry():
+            eng = _tiny_engine(model="m-a")
+            out = eng.score(np.zeros((2, 16), np.float32))
+            assert out.shape == (2,)
+            models = {e["model"] for e in cc.executable_store().entries()}
+            assert models == {"m-a"}
+            # explicit own-model submits serve normally
+            f = eng.submit("score", [0.0] * 16, model="m-a")
+            eng.flush()
+            assert np.isfinite(f.result())
+
+    def test_sharded_engine_model_boundary(self):
+        """The mesh-backed large-k engine inherits the whole model
+        contract: label threading, store-entry attribution, and the typed
+        unknown-model bad_request at submit."""
+        from iwae_replication_project_tpu.models import iwae as m
+        from iwae_replication_project_tpu.parallel.mesh import make_mesh
+        from iwae_replication_project_tpu.serving.sharded import (
+            ShardedScoreEngine)
+
+        D = 16
+        cfg = m.ModelConfig(x_dim=D, n_hidden_enc=(8,), n_latent_enc=(4,),
+                            n_hidden_dec=(8,), n_latent_dec=(D,))
+        params = m.init_params(jax.random.PRNGKey(0), cfg)
+        with cc.isolated_aot_registry():
+            eng = ShardedScoreEngine(params=params, model_config=cfg,
+                                     mesh=make_mesh(dp=1, sp=1), k=2,
+                                     k_chunk=2, k_max=8, max_batch=2,
+                                     model="m-sharded")
+            assert eng.model == "m-sharded"
+            assert eng.models == frozenset({"m-sharded"})
+            with pytest.raises(ValueError, match="unknown model"):
+                eng.submit("score", [0.0] * D, model="m-other")
+            out = eng.score(np.zeros((2, D), np.float32), k=5)
+            assert out.shape == (2,)
+            models = {e["model"] for e in cc.executable_store().entries()}
+            assert models == {"m-sharded"}
+
+    def test_per_model_latency_labels(self):
+        eng = _tiny_engine(model="m-a")
+        eng.score(np.zeros((2, 16), np.float32))
+        snap = eng.metrics.snapshot()
+        assert snap["model"] == "m-a"
+        assert any(key.startswith("m-a/score/") for key in snap["latency"])
+        flat = eng.metrics.flat()
+        assert any(key.startswith("latency/m-a/score/") for key in flat)
+        # the unlabeled engine keeps the historical schema
+        eng2 = _tiny_engine()
+        eng2.score(np.zeros((1, 16), np.float32))
+        assert any(key.startswith("score/")
+                   for key in eng2.metrics.snapshot()["latency"])
+
+
+# ---------------------------------------------------------------------------
+# router: capability snapshots + model routing (fakes, no device)
+# ---------------------------------------------------------------------------
+
+class ModelFakeEngine:
+    """Minimal engine surface with a model label; results encode WHICH
+    model served (seed*1000 + sum(row) + model tag) so misrouting is
+    visible in the value, not just the counters."""
+
+    def __init__(self, model, tag, dims=4):
+        self.model = model
+        self.models = frozenset({model})
+        self.row_dims = {"score": dims}
+        self.k = 5
+        self.tag = tag
+        self.submitted = []
+
+    def submit(self, op, row, k=None, *, seed=None, model=None):
+        if model is not None and model != self.model:
+            raise ValueError(f"unknown model {model!r}")
+        self.submitted.append((op, list(row), k, seed, model))
+        f = Future()
+        f.set_result(float(seed or 0) * 1000.0 + float(sum(row))
+                     + self.tag)
+        return f
+
+    def start(self):
+        pass
+
+    def stop(self, timeout_s=None):
+        pass
+
+    def warmup(self, ops=(), ks=None):
+        return {"programs": 0.0}
+
+
+class TestRouterModelRouting:
+    def _router(self):
+        from iwae_replication_project_tpu.serving.frontend import (
+            ReplicaRouter)
+
+        ea = ModelFakeEngine("m-a", tag=0.25)
+        eb = ModelFakeEngine("m-b", tag=0.5)
+        return ReplicaRouter([ea, eb]), ea, eb
+
+    def test_model_routes_to_declaring_replica(self):
+        router, ea, eb = self._router()
+        fa = router.submit("score", [1.0] * 4, model="m-a")
+        fb = router.submit("score", [1.0] * 4, model="m-b")
+        assert fa.result(timeout=5) != fb.result(timeout=5)
+        assert len(ea.submitted) == 1 and len(eb.submitted) == 1
+        assert ea.submitted[0][4] == "m-a"
+
+    def test_unknown_model_synchronous_bad_request(self):
+        router, ea, eb = self._router()
+        with pytest.raises(ValueError, match="unknown model"):
+            router.submit("score", [1.0] * 4, model="nope")
+        assert router.outstanding == 0   # nothing leaked past the reject
+        assert not ea.submitted and not eb.submitted
+
+    def test_default_model_resolution_is_deterministic(self):
+        """Model-less requests in an all-labeled fleet pin to the FIRST
+        replica's model at admission — replica choice can never pick the
+        weights."""
+        router, ea, eb = self._router()
+        assert router.default_model == "m-a"
+        for _ in range(4):
+            router.submit("score", [1.0] * 4).result(timeout=5)
+        assert len(ea.submitted) == 4 and not eb.submitted
+        assert all(s[4] == "m-a" for s in ea.submitted)
+
+    def test_affinity_keyed_per_model(self):
+        """Same (op, k) under different models are different affinity
+        groups — each sticks to its own replica."""
+        router, ea, eb = self._router()
+        for _ in range(3):
+            router.submit("score", [1.0] * 4, k=5, model="m-a")
+            router.submit("score", [1.0] * 4, k=5, model="m-b")
+        assert len(ea.submitted) == 3 and len(eb.submitted) == 3
+
+    def test_mixed_labeled_unlabeled_fleet(self):
+        """Unlabeled replicas keep serving model-less traffic (legacy);
+        labeled traffic only lands on its model's replicas."""
+        from iwae_replication_project_tpu.serving.frontend import (
+            ReplicaRouter)
+
+        class Unlabeled(ModelFakeEngine):
+            def __init__(self):
+                super().__init__("ignored", tag=0.125)
+                self.model = None
+                self.models = None
+
+            def submit(self, op, row, k=None, *, seed=None, model=None):
+                assert model is None, "unlabeled replica got a model tag"
+                return super().submit(op, row, k, seed=seed, model=None)
+
+        legacy = Unlabeled()
+        ea = ModelFakeEngine("m-a", tag=0.25)
+        router = ReplicaRouter([legacy, ea])
+        router.submit("score", [1.0] * 4).result(timeout=5)       # legacy
+        router.submit("score", [1.0] * 4, model="m-a").result(timeout=5)
+        assert len(legacy.submitted) == 1 and len(ea.submitted) == 1
+
+
+# ---------------------------------------------------------------------------
+# per-(client, model) quotas
+# ---------------------------------------------------------------------------
+
+class TestPerClientModelQuotas:
+    def test_model_lanes_are_isolated(self):
+        from iwae_replication_project_tpu.serving.frontend import (
+            ClientQuotas, QuotaExceeded, QuotaPolicy)
+
+        clk = type("C", (), {"t": 0.0, "__call__": lambda s: s.t})()
+        q = ClientQuotas(QuotaPolicy(rate=1.0, burst=2.0), clock=clk)
+        q.admit("alice", 2, model="m-a")          # drains alice x m-a
+        with pytest.raises(QuotaExceeded):
+            q.admit("alice", 1, model="m-a")
+        # same client, other model: full bucket — tenant lanes are isolated
+        q.admit("alice", 2, model="m-b")
+        # and the unlabeled lane is its own principal too
+        q.admit("alice", 2)
+        assert q.tokens("alice", model="m-a") == 0.0
+        assert q.tokens("alice", model="m-b") == 0.0
+        with pytest.raises(QuotaExceeded):
+            q.admit("alice", 1, model="m-b")
+        q.refund("alice", 1, model="m-b")
+        q.admit("alice", 1, model="m-b")
+        assert sorted(q.clients()) == ["alice"]
+
+
+# ---------------------------------------------------------------------------
+# wire boundary + RemoteEngine capability forwarding (real sockets, fakes)
+# ---------------------------------------------------------------------------
+
+class TestWireAndRemote:
+    def _tier(self, **kw):
+        from iwae_replication_project_tpu.serving.frontend import ServingTier
+
+        ea = ModelFakeEngine("m-a", tag=0.25)
+        eb = ModelFakeEngine("m-b", tag=0.5)
+        tier = ServingTier([ea, eb], port=0, **kw)
+        tier.start()
+        return tier, ea, eb
+
+    def test_unknown_model_typed_response_connection_survives(self):
+        from iwae_replication_project_tpu.serving.frontend import TierClient
+        from iwae_replication_project_tpu.serving.frontend.client import (
+            TierError)
+
+        tier, ea, eb = self._tier()
+        try:
+            with TierClient("127.0.0.1", tier.port) as cli:
+                with pytest.raises(TierError) as ei:
+                    cli.score([1.0] * 4, model="not-a-model")
+                assert ei.value.code == "bad_request"
+                assert "unknown model" in str(ei.value)
+                # non-string model is equally typed, and the connection
+                # still serves afterwards
+                rid = cli.submit("score", [1.0] * 4, model=123)
+                resp = cli.drain([rid])[rid]
+                assert resp["ok"] is False
+                assert resp["error"] == "bad_request"
+                out = cli.score([1.0] * 4, model="m-b")
+                assert out[0] == pytest.approx(4.5)   # m-b's tag
+        finally:
+            tier.stop(timeout_s=10)
+
+    def test_info_declares_models(self):
+        tier, _, _ = self._tier()
+        try:
+            info = tier.info()
+            assert sorted(info["models"]) == ["m-a", "m-b"]
+            assert info["default_model"] == "m-a"
+            assert info["models"]["m-b"]["ops"] == ["score"]
+            assert "store" in tier.stats()
+        finally:
+            tier.stop(timeout_s=10)
+
+    def test_default_model_and_named_model_share_one_quota_lane(self):
+        """The front end resolves a model-less request to the fleet's
+        default model BEFORE quota admission, so omitting the field cannot
+        mint a second (client, None) budget for the same weights."""
+        from iwae_replication_project_tpu.serving.frontend import (
+            QuotaPolicy, TierClient)
+        from iwae_replication_project_tpu.serving.frontend.client import (
+            TierError)
+
+        tier, _, _ = self._tier(
+            quota=QuotaPolicy(rate=0.001, burst=2.0))
+        try:
+            with TierClient("127.0.0.1", tier.port,
+                            client_id="alice") as cli:
+                cli.score([1.0] * 4)                    # lane (alice, m-a)
+                cli.score([1.0] * 4, model="m-a")       # SAME lane
+                with pytest.raises(TierError) as ei:
+                    cli.score([1.0] * 4)                # lane exhausted
+                assert ei.value.code == "quota_exceeded"
+                # the other model's lane is untouched
+                cli.score([1.0] * 4, model="m-b")
+        finally:
+            tier.stop(timeout_s=10)
+
+    def test_remote_engine_forwards_model_capabilities(self):
+        """A multi-model child tier proxies as ONE parent replica holding
+        the whole zoo: capability set from the info handshake, unknown
+        models rejected synchronously like the in-process engine."""
+        from iwae_replication_project_tpu.serving.frontend import RemoteEngine
+
+        tier, ea, eb = self._tier()
+        try:
+            proxy = RemoteEngine("127.0.0.1", tier.port)
+            assert proxy.models == frozenset({"m-a", "m-b"})
+            assert proxy.model == "m-a"
+            with pytest.raises(ValueError, match="unknown model"):
+                proxy.submit("score", [1.0] * 4, model="nope")
+            proxy.close()
+        finally:
+            tier.stop(timeout_s=10)
+
+
+def test_remote_engine_model_value_exact():
+    """Split out: exact value math for the forwarded-model request (seed 0
+    minted by the parent in admission order; the child tier re-admits with
+    the explicit seed, so the fake computes 0*1000 + sum(row) + tag)."""
+    from iwae_replication_project_tpu.serving.frontend import (
+        RemoteEngine, ReplicaRouter, ServingTier)
+
+    ea = ModelFakeEngine("m-a", tag=0.25)
+    eb = ModelFakeEngine("m-b", tag=0.5)
+    tier = ServingTier([ea, eb], port=0)
+    tier.start()
+    try:
+        proxy = RemoteEngine("127.0.0.1", tier.port)
+        parent = ReplicaRouter([proxy])
+        out = parent.submit("score", [1.0] * 4,
+                            model="m-b").result(timeout=5)
+        assert out == pytest.approx(0 * 1000.0 + 4.0 + 0.5)
+        assert eb.submitted and eb.submitted[0][4] == "m-b"
+        assert not ea.submitted
+        proxy.close()
+    finally:
+        tier.stop(timeout_s=10)

@@ -239,6 +239,74 @@ class TestExporters:
         assert flat["queue_wait/score/b4/count"] == 1.0
         assert flat["device_wait/score/b4/count"] == 1.0
 
+    def test_executable_store_schema(self):
+        """The multi-tenant executable store's telemetry contract (ISSUE
+        13): ``store/{hits,misses,evictions,demotions,readmits}`` counters
+        and the ``store/resident_bytes``-vs-budget gauges land on the
+        PROCESS registry (the Prometheus page every ``iwae-serve
+        --metrics-port`` run exports), and every ServingMetrics
+        snapshot/flat carries the same numbers under ``store``."""
+        import jax.numpy as jnp
+
+        from iwae_replication_project_tpu.serving.metrics import (
+            ServingMetrics)
+        from iwae_replication_project_tpu.telemetry.registry import (
+            get_registry)
+        from iwae_replication_project_tpu.utils import compile_cache as cc
+
+        @jax.jit
+        def probe(x):
+            return (x + 1.0).sum()
+
+        with cc.isolated_aot_registry(budget_bytes=None):
+            s0 = cc.cache_stats()
+            cc.aot_call("telemetry_probe", probe, (jnp.ones((4, 4)),),
+                        model="pin-model")
+            cc.aot_call("telemetry_probe", probe, (jnp.ones((4, 4)),),
+                        model="pin-model")
+            d = cc.stats_delta(s0)
+            assert d["store_misses"] == 1 and d["store_hits"] == 1
+            # process-registry surface (Prometheus page)
+            page = prometheus_text(get_registry())
+            assert "iwae_store_misses_total" in page
+            assert "iwae_store_hits_total" in page
+            assert "# TYPE iwae_store_resident_bytes gauge" in page
+            # ServingMetrics surface: snapshot["store"] + flat store/ keys
+            m = ServingMetrics()
+            snap = m.snapshot()
+            for key in ("hits", "misses", "evictions", "demotions",
+                        "readmits", "resident_bytes", "budget_bytes",
+                        "entries", "per_model"):
+                assert key in snap["store"], key
+            assert snap["store"]["entries"] == 1
+            assert "pin-model" in snap["store"]["per_model"]
+            flat = m.flat()
+            for key in ("store/hits", "store/misses", "store/evictions",
+                        "store/demotions", "store/readmits",
+                        "store/resident_bytes", "store/entries"):
+                assert isinstance(flat[key], float), key
+            assert "store/budget_bytes" not in flat   # unbounded: omitted
+
+    def test_model_labeled_latency_schema(self):
+        """A model-labeled engine's histograms carry the tenant in the key
+        on every surface — ``latency/<model>/<op>/b<n>`` flat/snapshot and
+        the Prometheus spelling — while the unlabeled schema is untouched
+        (pinned in test_serving.py)."""
+        from iwae_replication_project_tpu.serving.metrics import (
+            ServingMetrics)
+
+        m = ServingMetrics(model="zoo-x")
+        m.record_latency("score", 4, 0.004)
+        m.record_queue_wait("score", 4, 0.001)
+        snap = m.snapshot()
+        assert snap["model"] == "zoo-x"
+        assert "zoo-x/score/b4" in snap["latency"]
+        assert "zoo-x/score/b4" in snap["queue_wait"]
+        flat = m.flat()
+        assert flat["latency/zoo-x/score/b4/count"] == 1.0
+        page = prometheus_text(m.registry)
+        assert 'iwae_latency_zoo_x_score_b4{quantile="0.5"}' in page
+
 
 # ---------------------------------------------------------------------------
 # on-device diagnostics
