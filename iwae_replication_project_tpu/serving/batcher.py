@@ -82,6 +82,10 @@ class Request:
     #: on the device — splits observed latency into queue-wait
     #: (t_dispatch - t_enqueue) and device-wait (completion - t_dispatch)
     t_dispatch: Optional[float] = None
+    #: optional :class:`~..telemetry.tracing.TraceContext` the engine's
+    #: pipeline-stage spans attach under (None = untraced; the engine's
+    #: hot path then records nothing)
+    trace: Optional[Any] = None
 
     @property
     def group(self) -> Tuple[str, int]:

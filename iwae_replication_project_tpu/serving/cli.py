@@ -132,6 +132,15 @@ def build_argparser() -> argparse.ArgumentParser:
                       default=4096,
                       help="tier-wide admission ceiling (outstanding "
                            "requests) before typed 'overloaded' rejections")
+    tier.add_argument("--no-tracing", dest="tracing", action="store_false",
+                      default=True,
+                      help="disable end-to-end request tracing (on by "
+                           "default: every request's hop/queue/dispatch "
+                           "spans land in the tail-sampled flight "
+                           "recorder, dumpable via the 'traces' wire op, "
+                           "the /traces endpoint on --metrics-port, and "
+                           "the iwae-trace CLI; results are bitwise "
+                           "identical either way)")
     tier.add_argument("--sharded-replicas", dest="sharded_replicas",
                       type=int, default=0,
                       help="additionally run N mesh-backed large-k score "
@@ -360,18 +369,22 @@ def _tier_mode(args, ops) -> int:
     tier = ServingTier(_build_replicas(args, args.replicas), quota=quota,
                        max_outstanding=args.max_outstanding,
                        host=args.host, port=args.port,
-                       large_k_threshold=threshold)
+                       large_k_threshold=threshold,
+                       tracing=args.tracing)
     warm = tier.warmup(ops=ops)
     tier.start()
     metrics_srv = None
     if args.metrics_port is not None:
         from iwae_replication_project_tpu.telemetry import (
             get_registry, start_metrics_server)
-        # process spans + the router's gauges/counters; per-replica engine
-        # histograms stay in the shutdown snapshot (their unprefixed names
-        # would collide across replicas on one exposition page)
+        # process spans + the router's gauges/counters (incl. the slo/*
+        # burn-rate gauges); per-replica engine histograms stay in the
+        # shutdown snapshot (their unprefixed names would collide across
+        # replicas on one exposition page). The tier's flight recorder
+        # additionally serves /traces as Chrome trace-event JSON.
         metrics_srv = start_metrics_server(
-            (get_registry(), tier.registry), args.metrics_port)
+            (get_registry(), tier.registry), args.metrics_port,
+            recorder=tier.recorder)
     info = tier.info()
     print(json.dumps({
         "tier": {"replicas": args.replicas,

@@ -97,6 +97,11 @@ class _InFlight:
     #: executable-store pin held for the dispatch lifetime: the store's LRU
     #: eviction must never pull this batch's program while it is in flight
     pin: Any = None
+    #: launch-stage timestamps (engine clock) for the per-request trace
+    #: spans assembled at completion: _launch entry (coalesce boundary) and
+    #: end of pad+device_put (the AOT enqueue start)
+    t_launch0: float = 0.0
+    t_args: float = 0.0
 
 
 class ServingEngine:
@@ -201,6 +206,11 @@ class ServingEngine:
         #: whether this replica runs the mesh-sharded large-k path — the
         #: replica router's classification bit (serving/frontend/router.py)
         self.sharded = False
+        #: capability bit the replica router reads before forwarding a
+        #: trace context: this engine accepts ``submit(trace=)`` and emits
+        #: pipeline-stage spans (fakes without the attribute read as
+        #: untraceable and never see the kwarg)
+        self.traces = True
         #: op -> (jitted program, takes k?) — instance-level so mesh-backed
         #: subclasses swap programs without touching the dispatch machinery
         self._programs: Dict[str, tuple] = dict(PROGRAMS)
@@ -244,9 +254,17 @@ class ServingEngine:
 
     def submit(self, op: str, row, k: Optional[int] = None, *,
                seed: Optional[int] = None,
-               model: Optional[str] = None) -> Future:
+               model: Optional[str] = None,
+               trace=None) -> Future:
         """Enqueue ONE example; returns its Future. Raises
         :class:`EngineOverloaded` when the queue bound is hit.
+
+        ``trace`` is an optional
+        :class:`~..telemetry.tracing.TraceContext`: the engine's pipeline
+        stages (queue → pad → AOT dispatch → device → fetch) are then
+        recorded as child spans of it at completion time.  Tracing is pure
+        host-side metadata: it never touches seeds, payloads, or program
+        shapes, so results are bitwise identical with or without it.
 
         ``model`` asserts WHICH tenant's weights must serve the request: a
         name other than this engine's own is the typed ``bad_request``
@@ -297,7 +315,8 @@ class ServingEngine:
                 self._seed_counter = (self._seed_counter + 1) % (2 ** 31)
             req = Request(op=op, payload=row, k=k, seed=seed, t_enqueue=now,
                           deadline=(now + self.timeout_s
-                                    if self.timeout_s is not None else None))
+                                    if self.timeout_s is not None else None),
+                          trace=trace)
             try:
                 self._batcher.submit(req)
             except EngineOverloaded:
@@ -543,6 +562,10 @@ class ServingEngine:
         # is the replica-crash signal — it propagates into _launch_routed
         # and lands in exactly this batch's futures
         fault_point(SITE_ENGINE_LAUNCH, engine=self, op=op, k=k, batch=n)
+        # trace-stage timestamps: stamped unconditionally (two clock reads)
+        # so the hot path does no per-request tracing work — the spans are
+        # assembled at completion, and only for traced requests
+        t_launch0 = self._clock()
         bucket = self.ladder.bucket_for(n)
         payload = self.ladder.pad_rows(
             np.stack([r.payload for r in batch]), bucket)
@@ -550,6 +573,7 @@ class ServingEngine:
         seeds[:n] = [r.seed for r in batch]
         program = self._program_for(op, k, bucket)
         args, kwargs, static = self._dispatch_args(op, k, payload, seeds)
+        t_args = self._clock()
         # stamp the gate's selection for THIS dispatch's (op, k, bucket) —
         # recomputed from the row's own config via the deterministic gate
         # memo, never read from trace-order state (the PR 6 contract)
@@ -589,7 +613,7 @@ class ServingEngine:
         self.metrics.count("aot_misses", d["aot_misses"])
         self.metrics.count("recompiles", d["persistent_cache_misses"])
         return _InFlight(batch=batch, op=op, k=k, bucket=bucket, out=out,
-                         pin=pin)
+                         pin=pin, t_launch0=t_launch0, t_args=t_args)
 
     def _launch_routed(self, batch: List[Request]) -> Optional[_InFlight]:
         """:meth:`_launch` with enqueue-failure routing: an exception lands
@@ -608,12 +632,42 @@ class ServingEngine:
         failures) surface here."""
         return np.asarray(out)  # iwaelint: disable=host-sync -- the completion stage's designated fetch: blocking D2H is this thread's entire job; the dispatch hot path stays sync-free
 
+    def _trace_attrs(self, op: str, k: int, bucket: int, n: int) -> dict:
+        """Attrs stamped on a traced dispatch's ``engine/dispatch`` span
+        (the mesh-sharded subclass adds its chunk/mesh shape here)."""
+        return {"op": op, "k": k, "bucket": bucket, "batch": n,
+                "program": self._aot_name(op)}
+
+    def _emit_trace_spans(self, inf: _InFlight, t_fetch0: float,
+                          now: float, error: Optional[str] = None) -> None:
+        """Per-request pipeline-stage spans, assembled from the timestamps
+        the hot path stamped (queue → pad → dispatch → device → fetch) —
+        recorded only for traced requests, at completion, off the dispatch
+        hot path."""
+        traced = [r for r in inf.batch if r.trace is not None]
+        if not traced:
+            return
+        from iwae_replication_project_tpu.telemetry.tracing import emit_span
+
+        attrs = self._trace_attrs(inf.op, inf.k, inf.bucket, len(inf.batch))
+        for r in traced:
+            ctx = r.trace
+            emit_span(ctx, "engine/queue", r.t_enqueue, inf.t_launch0)
+            emit_span(ctx, "engine/pad", inf.t_launch0, inf.t_args)
+            emit_span(ctx, "engine/dispatch", inf.t_args,
+                      r.t_dispatch if r.t_dispatch is not None
+                      else inf.t_args, attrs=attrs)
+            if r.t_dispatch is not None:
+                emit_span(ctx, "engine/device", r.t_dispatch, t_fetch0)
+            emit_span(ctx, "engine/fetch", t_fetch0, now, error=error)
+
     def _finish(self, inf: _InFlight) -> None:
         """Stage two: fetch, slice padding, complete this batch's futures.
         A fetch failure (async device errors surface at the transfer) is
         routed to exactly this in-flight batch's futures."""
         from iwae_replication_project_tpu.telemetry.spans import span
 
+        t_fetch0 = self._clock()
         try:
             with span(f"serve/complete/{inf.op}",
                       registry=self.metrics.registry):
@@ -625,6 +679,8 @@ class ServingEngine:
         except Exception as e:
             if inf.pin is not None:
                 inf.pin.release()
+            self._emit_trace_spans(inf, t_fetch0, self._clock(),
+                                   error="internal")
             for r in inf.batch:
                 self.metrics.count("errors")
                 self._complete(r.future, exc=e)
@@ -634,8 +690,11 @@ class ServingEngine:
             # evict this program again under budget pressure
             inf.pin.release()
         now = self._clock()
+        self._emit_trace_spans(inf, t_fetch0, now)
         for i, r in enumerate(inf.batch):
-            self.metrics.record_latency(inf.op, inf.bucket, now - r.t_enqueue)
+            self.metrics.record_latency(
+                inf.op, inf.bucket, now - r.t_enqueue,
+                trace_id=(r.trace.trace_id if r.trace is not None else None))
             if r.t_dispatch is not None:
                 self.metrics.record_queue_wait(inf.op, inf.bucket,
                                                r.t_dispatch - r.t_enqueue)

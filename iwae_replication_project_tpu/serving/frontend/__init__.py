@@ -39,6 +39,17 @@ scored with k-sample IWAE log p̂(x) (arXiv:1509.00519), seeds are minted at
 tier admission in arrival order and carried through routing — so results
 are bitwise identical to a direct single-engine run no matter how the fleet
 routed, rerouted, or padded the work.
+
+Observability rides the same path: every request carries a trace context
+(telemetry/tracing.py — minted by the front end or accepted from the wire
+``trace`` field) whose spans cover admission, router dispatch attempts,
+RemoteEngine hops, and the engine pipeline stages, landing as one tree per
+request in the tail-sampled flight recorder (``traces`` control op,
+``/traces`` endpoint, ``iwae-trace`` CLI); the front end also feeds each
+completion into the SLO burn-rate monitor (telemetry/slo.py), whose
+``slo/*`` gauges share the tier registry's Prometheus page with
+``router/*``.  Both are host-side metadata only — serving bits are
+identical with them on or off.
 """
 
 from iwae_replication_project_tpu.serving.frontend.client import TierClient

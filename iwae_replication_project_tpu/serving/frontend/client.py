@@ -68,12 +68,26 @@ class TierClient:
     def __init__(self, host: str, port: int, *,
                  client_id: Optional[str] = None,
                  timeout_s: Optional[float] = 60.0,
-                 retry: Optional[RetryPolicy] = None):
+                 retry: Optional[RetryPolicy] = None,
+                 trace: bool = False, recorder=None):
         self.client_id = client_id
         self._host = host
         self._port = port
         self._timeout_s = timeout_s
         self._retry = retry
+        # opt-in client-side tracing (telemetry/tracing.py): every request
+        # roots a ``client/request`` span (retry/hedge attempts become
+        # indexed children) and rides the wire ``trace`` field, so the
+        # tier's tree hangs under the CLIENT's view of the request —
+        # including the attempts the server never saw finish
+        self._trace = bool(trace)
+        if self._trace and recorder is None:
+            from iwae_replication_project_tpu.telemetry.tracing import (
+                get_recorder)
+            recorder = get_recorder()
+        self._recorder = recorder
+        #: wire id -> open auto-minted root span (pipelined/no-retry path)
+        self._spans: Dict[int, Any] = {}
         self._next_id = 0
         self._retry_streams = 0
         #: id -> response, for replies read while waiting on another id
@@ -99,6 +113,13 @@ class TierClient:
 
     def _disconnect(self) -> None:
         sock, self._sock, self._reader = self._sock, None, None
+        # pipelined-mode root spans die with the connection: their wire ids
+        # can never be answered again, so close them errored NOW (a trace
+        # that waited for the recorder TTL would read as abandoned, and the
+        # map would grow forever across reconnects — ids never repeat)
+        spans, self._spans = self._spans, {}
+        for sp in spans.values():
+            sp.finish(error="connection")
         if sock is not None:
             try:
                 sock.shutdown(socket.SHUT_RDWR)
@@ -119,13 +140,18 @@ class TierClient:
 
     def submit(self, op: str, x, k: Optional[int] = None,
                seed: Optional[int] = None,
-               model: Optional[str] = None) -> int:
+               model: Optional[str] = None, trace=None) -> int:
         """Send one request without waiting; returns its wire id. ``seed``
         (single-row payloads only) pins the row's RNG stream — the
         fleet-composition AND retry-parity hook (see protocol.py);
         ordinary non-retrying callers leave it unset. ``model`` names the
         tenant whose weights must serve the request (a multi-model tier;
-        unknown names come back as typed ``bad_request`` responses)."""
+        unknown names come back as typed ``bad_request`` responses).
+        ``trace`` (a :class:`~...telemetry.tracing.TraceContext`) attaches
+        the request under an existing span; with client tracing on
+        (``TierClient(trace=True)``) and no explicit context, each submit
+        roots its own ``client/request`` span, closed when its response is
+        read by :meth:`wait`/:meth:`drain`."""
         if self._sock is None:
             raise ConnectionError("client is disconnected (a prior "
                                   "connection failure); blocking requests "
@@ -141,8 +167,25 @@ class TierClient:
             req["model"] = model
         if self.client_id is not None:
             req["client"] = self.client_id
+        if trace is not None:
+            req["trace"] = trace.wire()
+        elif self._trace:
+            from iwae_replication_project_tpu.telemetry.tracing import (
+                start_span)
+            span = start_span("client/request", recorder=self._recorder,
+                              attrs={"op": op})
+            self._spans[req_id] = span
+            req["trace"] = span.ctx().wire()
         self._sock.sendall(protocol.encode_line(req))
         return req_id
+
+    def _finish_span(self, req_id: int, resp: Dict[str, Any]) -> None:
+        """Close the auto-minted root span of a pipelined request once its
+        response has been read (error-coded when the tier said no)."""
+        span = self._spans.pop(req_id, None)
+        if span is not None:
+            span.finish(error=None if resp.get("ok")
+                        else resp.get("error", "internal"))
 
     def _read_one(self) -> Dict[str, Any]:
         line = self._reader.next_line()
@@ -157,6 +200,7 @@ class TierClient:
             resp = self._read_one()
             self._responses[resp.get("id")] = resp
         resp = self._responses.pop(req_id)
+        self._finish_span(req_id, resp)
         if not resp.get("ok"):
             raise TierError(resp.get("error", "internal"),
                             resp.get("message", ""),
@@ -180,6 +224,8 @@ class TierClient:
                 want.discard(rid)
             else:
                 self._responses[rid] = resp
+        for rid, resp in out.items():
+            self._finish_span(rid, resp)
         return out
 
     # -- blocking API -------------------------------------------------------
@@ -221,46 +267,75 @@ class TierClient:
         backoff = policy.backoff(self._retry_streams)
         deadline = None if policy.deadline_s is None \
             else time.monotonic() + policy.deadline_s
+        root = None
+        if self._trace:
+            from iwae_replication_project_tpu.telemetry.tracing import (
+                start_span)
+            root = start_span("client/request", recorder=self._recorder,
+                              attrs={"op": op})
         last: Optional[BaseException] = None
-        for attempt in range(1, policy.max_attempts + 1):
-            hint = None
-            try:
-                self._ensure_connected()
-                rid = self.submit(op, x, k=k, seed=seed, model=model)
-                return self._await(rid, op, x, k, seed, model, deadline)
-            except TierError as e:
-                if not policy.retryable(e.code) or (
-                        e.code == "quota_exceeded"
-                        and e.retry_after_s is None):
-                    # a quota rejection WITHOUT a refill hint is the
-                    # cost-above-burst case: no wait can ever admit it —
-                    # the request must be split, not re-sent
-                    raise
-                last, hint = e, e.retry_after_s
-            except (OSError, protocol.ProtocolError) as e:
-                if self._closed:
-                    raise       # use-after-close is an error, not a retry
-                # dropped (OSError/ConnectionError) or garbled
-                # (ProtocolError) connection: the stream is unusable —
-                # reconnect before the next attempt
-                self._disconnect()
-                if not policy.retry_connection_errors:
-                    raise
-                last = e
-            if attempt >= policy.max_attempts:
-                break
-            sleep_s = backoff.next_delay(hint)
-            if deadline is not None and \
-                    time.monotonic() + sleep_s > deadline:
-                break
-            self.retry_stats["retries"] += 1
-            time.sleep(sleep_s)
-        raise last
+        try:
+            for attempt in range(1, policy.max_attempts + 1):
+                hint = None
+                # attempt-indexed child span: a retried request's tree
+                # shows every send, including ones the tier never answered
+                aspan = root.child(f"client/attempt-{attempt}") \
+                    if root is not None else None
+                try:
+                    self._ensure_connected()
+                    rid = self.submit(op, x, k=k, seed=seed, model=model,
+                                      trace=(aspan.ctx() if aspan is not None
+                                             else None))
+                    out = self._await(rid, op, x, k, seed, model, deadline,
+                                      span=aspan)
+                    if aspan is not None:
+                        aspan.finish()
+                    if root is not None:
+                        root.finish()
+                    return out
+                except TierError as e:
+                    if aspan is not None:
+                        aspan.finish(error=e.code)
+                    if not policy.retryable(e.code) or (
+                            e.code == "quota_exceeded"
+                            and e.retry_after_s is None):
+                        # a quota rejection WITHOUT a refill hint is the
+                        # cost-above-burst case: no wait can ever admit it —
+                        # the request must be split, not re-sent
+                        raise
+                    last, hint = e, e.retry_after_s
+                except (OSError, protocol.ProtocolError) as e:
+                    if aspan is not None:
+                        aspan.finish(error="connection")
+                    if self._closed:
+                        raise   # use-after-close is an error, not a retry
+                    # dropped (OSError/ConnectionError) or garbled
+                    # (ProtocolError) connection: the stream is unusable —
+                    # reconnect before the next attempt
+                    self._disconnect()
+                    if not policy.retry_connection_errors:
+                        raise
+                    last = e
+                if attempt >= policy.max_attempts:
+                    break
+                sleep_s = backoff.next_delay(hint)
+                if deadline is not None and \
+                        time.monotonic() + sleep_s > deadline:
+                    break
+                self.retry_stats["retries"] += 1
+                time.sleep(sleep_s)
+            raise last
+        finally:
+            if root is not None:
+                # idempotent: a no-op after the success-path finish; on any
+                # raise this closes the root errored so the trace finalizes
+                root.finish(error="failed")
 
     def _await(self, rid: int, op: str, x, k, seed, model,
-               deadline: Optional[float]) -> List[Any]:
+               deadline: Optional[float], span=None) -> List[Any]:
         """Wait for `rid`, hedging to a second connection when the policy
-        asks for it and the primary is slow."""
+        asks for it and the primary is slow. ``span`` is the attempt span
+        a hedge records its ``client/hedge`` child under."""
         policy = self._retry
         if policy.hedge_after_s is None:
             return self.wait(rid)
@@ -281,11 +356,17 @@ class TierClient:
         primary_broken = False
         hedge = TierClient(self._host, self._port, client_id=self.client_id,
                            timeout_s=self._timeout_s)
+        # the hedge span opens only once the dial succeeded: a refused dial
+        # raises out of here with no orphaned open span (the attempt span's
+        # error closure keeps the trace finalizable)
+        hspan = span.child("client/hedge") if span is not None else None
         # everything past the hedge dial runs under the finally that closes
         # it: a submit that dies on a freshly-reset connection must not
         # leak the hedge socket (nor skip the primary cleanup decision)
         try:
-            hrid = hedge.submit(op, x, k=k, seed=seed, model=model)
+            hrid = hedge.submit(op, x, k=k, seed=seed, model=model,
+                                trace=(hspan.ctx() if hspan is not None
+                                       else None))
             results: "_queue.Queue" = _queue.Queue()
 
             def waiter(tag: str, cli: "TierClient", r: int) -> None:
@@ -300,6 +381,7 @@ class TierClient:
                                  daemon=True).start()
             tag, err, value = self._race(results, deadline)
             finished.add(tag)
+            self._finish_hedge_span(hspan, tag, err)
             primary_broken |= tag == "primary" and \
                 isinstance(err, (OSError, protocol.ProtocolError))
             if err is None:
@@ -310,6 +392,7 @@ class TierClient:
             # wait it out within the deadline, else surface the error
             tag2, err2, value2 = self._race(results, deadline)
             finished.add(tag2)
+            self._finish_hedge_span(hspan, tag2, err2)
             primary_broken |= tag2 == "primary" and \
                 isinstance(err2, (OSError, protocol.ProtocolError))
             if err2 is None:
@@ -323,9 +406,21 @@ class TierClient:
             # socket is dropped server-side), and the primary is abandoned
             # too when a waiter may still be blocked on it — or when its
             # stream broke. It reconnects lazily on the next request.
+            if hspan is not None and "hedge" not in finished:
+                # the race ended before the hedge leg reported: close its
+                # span so the trace can finalize (the tier-side subtree
+                # still lands — the tier answers even a vanished client)
+                hspan.finish(error="abandoned")
             hedge.close()
             if "primary" not in finished or primary_broken:
                 self._disconnect()
+
+    @staticmethod
+    def _finish_hedge_span(hspan, tag: str, err) -> None:
+        if hspan is None or tag != "hedge":
+            return
+        hspan.finish(error=None if err is None else (
+            err.code if isinstance(err, TierError) else "connection"))
 
     @staticmethod
     def _race(results: "_queue.Queue", deadline: Optional[float]):
@@ -340,11 +435,12 @@ class TierClient:
 
     # -- control ops --------------------------------------------------------
 
-    def _control(self, op: str) -> Dict[str, Any]:
+    def _control(self, op: str, **fields) -> Dict[str, Any]:
         self._ensure_connected()
         self._next_id += 1
-        self._sock.sendall(protocol.encode_line(
-            {"id": self._next_id, "op": op}))
+        req: Dict[str, Any] = {"id": self._next_id, "op": op}
+        req.update({k: v for k, v in fields.items() if v is not None})
+        self._sock.sendall(protocol.encode_line(req))
         return self.wait(self._next_id)
 
     def info(self) -> Dict[str, Any]:
@@ -355,6 +451,15 @@ class TierClient:
         """The tier's live ``stats`` document (router counters/gauges,
         replica health, per-engine counters)."""
         return self._control("stats")
+
+    def traces(self, limit: Optional[int] = None,
+               trace_id: Optional[str] = None,
+               fmt: Optional[str] = None) -> Dict[str, Any]:
+        """The tier's flight-recorder dump (``traces`` control op): raw
+        trace documents + recorder stats, or — ``fmt="chrome"`` — one
+        Chrome trace-event JSON object (what ``iwae-trace`` writes)."""
+        return self._control("traces", limit=limit, trace_id=trace_id,
+                             format=fmt)
 
     def close(self) -> None:
         self._closed = True

@@ -12,6 +12,7 @@ Request object::
      "id": <any JSON value>,                 # optional, echoed verbatim
      "client": "tenant-a",                   # optional quota principal
      "model": "table1-iwae-1l-k50",          # optional tenant model
+     "trace": "<tid>[/<span>]",              # optional trace context
      "seed": 17}                             # optional, single-row only
 
 ``model`` names WHICH zoo model's weights must serve the request on a
@@ -21,6 +22,17 @@ the fleet does not declare is a typed ``bad_request`` — never a silent
 answer from the wrong weights. Absent, the tier's ``default_model``
 serves (the ``info`` doc names it, plus a per-model capability table under
 ``models``).
+
+``trace`` is the request's distributed-tracing context
+(telemetry/tracing.py): ``"<trace-id>"`` or
+``"<trace-id>/<parent-span-id>"``, each part 1-64 chars of
+``[A-Za-z0-9_.:-]``.  Absent, a tracing-enabled front end mints a fresh
+trace; present, the request's spans join the caller's tree (the
+fleet-of-fleets hook — a parent tier's RemoteEngine hop span parents the
+child tier's request span).  A malformed or oversized ``trace`` is a typed
+``bad_request`` *response* and the connection survives, like every other
+field.  Tracing is host-side metadata only: it never reaches seeds,
+payloads, or program shapes, so results are bitwise independent of it.
 
 ``seed`` is the fleet-composition hook: serving results are a pure function
 of (weights, payload, seed, k), so a PARENT router that mints its own seeds
@@ -36,8 +48,12 @@ dims, default k, bucket ladder, replica count) — clients use it to size
 payloads — and ``{"op": "stats"}`` likewise returns the live router
 counters/gauges plus each replica engine's counter snapshot (what the
 bench's zero-recompile proof and the smoke's failure accounting read over
-the wire). Control ops are never routed, quota'd, or counted against the
-ceiling.
+the wire). ``{"op": "traces"}`` dumps the tier's flight recorder
+(telemetry/tracing.py): optional ``limit`` (most recent N), ``trace_id``
+(one trace), and ``format`` (``"raw"`` trace documents, the default, or
+``"chrome"`` for a Chrome trace-event JSON object — what the
+``iwae-trace`` CLI fetches). Control ops are never routed, quota'd, or
+counted against the ceiling.
 
 Response object::
 
@@ -84,7 +100,7 @@ ERROR_CODES = ("bad_request", "overloaded", "quota_exceeded", "timeout",
                "unavailable", "internal")
 
 #: protocol ops the front end answers itself (never routed to a replica)
-CONTROL_OPS = ("info", "stats")
+CONTROL_OPS = ("info", "stats", "traces")
 
 #: max accepted request line (bytes) — a framing bound, not a row bound:
 #: 64 MiB comfortably fits a max_batch x 784-float payload and stops a

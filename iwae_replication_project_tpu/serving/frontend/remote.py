@@ -85,6 +85,12 @@ _CODE_EXC = {
 class RemoteEngine:
     """The engine surface over one TCP connection to a serving tier."""
 
+    #: capability bit for a parent router: the proxy accepts
+    #: ``submit(trace=)`` — the hop is recorded as a ``remote/hop`` span
+    #: and the context rides the wire's ``trace`` field, so the child
+    #: tier's request span joins the SAME tree (fleet-of-fleets tracing)
+    traces = True
+
     def __init__(self, host: str, port: int, *,
                  connect_timeout_s: float = 30.0,
                  retry: Optional[RetryPolicy] = None):
@@ -95,6 +101,9 @@ class RemoteEngine:
         self._idle = threading.Condition(self._lock)
         #: wire id -> Future for every in-flight request (guarded by _lock)
         self._pending: Dict[int, Future] = {}
+        #: wire id -> open remote/hop Span for traced requests (guarded by
+        #: _lock beside _pending; finished outside it)
+        self._spans: Dict[int, Any] = {}
         self._next_id = 0
         self._dead: Optional[str] = None  # poison reason once connection dies
         self._closed = False              # close() is final even under retry
@@ -223,8 +232,14 @@ class RemoteEngine:
 
     def submit(self, op: str, row, k: Optional[int] = None, *,
                seed: Optional[int] = None,
-               model: Optional[str] = None) -> Future:
+               model: Optional[str] = None,
+               trace=None) -> Future:
         """One row to the child tier; returns the proxy Future.
+
+        ``trace`` (a :class:`~...telemetry.tracing.TraceContext`) records
+        this hop as a ``remote/hop`` span — open from send to response —
+        and forwards the context on the wire, so the child tier's spans
+        join the parent's tree.
 
         Validation (unknown op/model, wrong feature count, poisoned
         connection) raises synchronously, exactly like the in-process
@@ -261,16 +276,28 @@ class RemoteEngine:
         # (the parent's warm probe lands here); otherwise — or after
         # close() — the poison is final. The dial runs outside the lock.
         self._reconnect_if_needed()
+        hop = None
+        if trace is not None:
+            from iwae_replication_project_tpu.telemetry.tracing import (
+                start_span)
+            hop = start_span("remote/hop", ctx=trace,
+                             attrs={"host": self._addr[0],
+                                    "port": self._addr[1], "op": op})
+            req["trace"] = hop.ctx().wire()
         fut: Future = Future()
         with self._lock:
             if self._dead is not None:
                 # died again between the reconnect check and the send
+                if hop is not None:
+                    hop.finish(error="unavailable")
                 raise ReplicaUnavailable(
                     f"remote tier {self._addr[0]}:{self._addr[1]} is gone "
                     f"({self._dead})")
             self._next_id += 1
             req["id"] = self._next_id
             self._pending[self._next_id] = fut
+            if hop is not None:
+                self._spans[self._next_id] = hop
             try:
                 # chaos hook: an injected OSError severs the proxy exactly
                 # like a mid-send connection loss
@@ -278,7 +305,10 @@ class RemoteEngine:
                 self._sock.sendall(protocol.encode_line(req))
             except OSError as e:
                 del self._pending[self._next_id]
+                self._spans.pop(self._next_id, None)
                 self._dead = f"send failed: {e}"
+                if hop is not None:
+                    hop.finish(error="unavailable")
                 raise ReplicaUnavailable(
                     f"remote tier send failed: {e}") from None
         return fut
@@ -321,18 +351,23 @@ class RemoteEngine:
                 if gen != self._gen:
                     return      # superseded connection: not ours to serve
                 fut = self._pending.pop(resp.get("id"), None)
+                hop = self._spans.pop(resp.get("id"), None)
                 self._idle.notify_all()
             if fut is None:
                 continue        # duplicate/unknown id: first-wins upstream
             # complete OUTSIDE the lock: the parent router's callback may
             # re-enter submit()
             if resp.get("ok"):
+                if hop is not None:
+                    hop.finish()
                 result = resp.get("result")
                 # one submit = one row; unwrap the per-row result list
                 _complete(fut, result=result[0]
                           if isinstance(result, list) and len(result) == 1
                           else result)
             else:
+                if hop is not None:
+                    hop.finish(error=resp.get("error", "internal"))
                 exc_type = _CODE_EXC.get(resp.get("error", "internal"),
                                          RuntimeError)
                 _complete(fut, exc=exc_type(resp.get("message", "")))
@@ -348,7 +383,11 @@ class RemoteEngine:
                 self._dead = reason
             orphans = list(self._pending.values())
             self._pending.clear()
+            orphan_spans = list(self._spans.values())
+            self._spans.clear()
             self._idle.notify_all()
+        for hop in orphan_spans:
+            hop.finish(error="unavailable")
         for fut in orphans:
             _complete(fut, exc=ReplicaUnavailable(
                 f"remote tier connection lost: {reason}"))

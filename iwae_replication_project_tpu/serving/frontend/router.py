@@ -119,6 +119,13 @@ class _Tracked:
     future: Future
     #: resolved tenant (None only in an unlabeled single-model fleet)
     model: Optional[str] = None
+    #: tracing context the attempt spans attach under (None = untraced)
+    trace: Any = None
+    #: the CURRENT dispatch attempt's open span — written by the flow that
+    #: owns the request at that moment (dispatch path, or the owning
+    #: engine-future callback; `owns` gates every touch), finished exactly
+    #: once per attempt before the next attempt opens its own
+    span: Any = None
     attempts: int = 0
     replica_index: int = -1
     t_dispatch: float = 0.0
@@ -134,7 +141,7 @@ class _Replica:
     fleet has one synchronization domain, not N+1."""
 
     __slots__ = ("index", "engine", "healthy", "outstanding", "last_error",
-                 "sharded", "k_max", "ops", "model", "models")
+                 "sharded", "k_max", "ops", "model", "models", "traces")
 
     def __init__(self, index: int, engine):
         self.index = index
@@ -160,6 +167,10 @@ class _Replica:
         self.models: Optional[frozenset] = \
             frozenset(ms) if ms else \
             (frozenset({self.model}) if self.model is not None else None)
+        # tracing capability: whether the engine accepts submit(trace=) and
+        # records pipeline-stage spans. Fakes without the bit never see the
+        # kwarg — the router's attempt spans still cover the dispatch.
+        self.traces = bool(getattr(engine, "traces", False))
 
     def serves(self, op: str) -> bool:
         return self.ops is None or op in self.ops
@@ -286,6 +297,13 @@ class ReplicaRouter:
         with self._lock:
             return self._outstanding_total
 
+    def serves_op(self, op: str) -> bool:
+        """Whether ANY replica serves `op` (capability sets are immutable
+        per engine, so no lock is needed — same basis as submit's check).
+        The front end's SLO accounting uses this to keep garbage op names
+        from minting burn-rate gauges."""
+        return any(r.serves(op) for r in self._replicas)
+
     def replica_states(self) -> List[dict]:
         with self._lock:
             return [{"index": r.index, "healthy": r.healthy,
@@ -296,8 +314,17 @@ class ReplicaRouter:
 
     def submit(self, op: str, row, k: Optional[int] = None, *,
                seed: Optional[int] = None,
-               model: Optional[str] = None) -> Future:
+               model: Optional[str] = None,
+               trace=None) -> Future:
         """Admit and dispatch one request row; returns the tier Future.
+
+        ``trace`` is an optional
+        :class:`~...telemetry.tracing.TraceContext`: every dispatch
+        attempt then records an attempt-indexed child span
+        (``router/attempt-<n>``, attrs: replica index) — a reroute after a
+        replica failure shows up as attempt-1 (errored) + attempt-2, and
+        the engine's pipeline-stage spans nest under the attempt that
+        served the request.
 
         ``model`` names the tenant whose weights must serve the row; a
         model no replica declares is a synchronous ValueError (the typed
@@ -344,7 +371,8 @@ class ReplicaRouter:
                 self._seed_counter = (self._seed_counter + 1) % (2 ** 31)
             self._ticket_counter += 1
             t = _Tracked(ticket=self._ticket_counter, op=op, row=row, k=k,
-                         seed=int(seed), future=fut, model=model)
+                         seed=int(seed), future=fut, model=model,
+                         trace=trace)
             self._outstanding_total += 1
             self.registry.gauge("router/outstanding").set(
                 self._outstanding_total)
@@ -419,9 +447,25 @@ class ReplicaRouter:
         self._affinity[group] = chosen.index
         return chosen
 
+    @staticmethod
+    def _finish_span(t: _Tracked,
+                     exc: Optional[BaseException] = None) -> None:
+        """Close the request's CURRENT attempt span (no-op when untraced),
+        stamping the typed error code when the attempt failed."""
+        span, t.span = t.span, None
+        if span is None:
+            return
+        if exc is None:
+            span.finish()
+        else:
+            from iwae_replication_project_tpu.serving.frontend.protocol \
+                import error_code_for
+            span.finish(error=error_code_for(exc))
+
     def _dispatch(self, t: _Tracked, exclude: Set[int]) -> None:
         """Place `t` on a replica, walking past sheds and submit-time
         failures; raises the typed error when the fleet cannot take it."""
+        from iwae_replication_project_tpu.telemetry.tracing import start_span
         any_shed = False
         while True:
             with self._lock:
@@ -433,6 +477,12 @@ class ReplicaRouter:
                 t.attempts += 1
                 t.t_dispatch = self._clock()
                 self._publish_replica(r)
+            if t.trace is not None:
+                # attempt-indexed child span: a rerouted request's tree
+                # shows attempt-1 (errored) + attempt-2 side by side
+                t.span = start_span(f"router/attempt-{t.attempts}",
+                                    ctx=t.trace,
+                                    attrs={"replica": r.index, "op": t.op})
             try:
                 # chaos hook inside the try: an injected raise is attributed
                 # to THIS replica (submit-time failure path), like a real one
@@ -440,22 +490,27 @@ class ReplicaRouter:
                             replica=r.index, attempt=t.attempts)
                 # outside the lock: engine.submit takes the engine's own
                 # lock and may block briefly; the router lock never nests
-                # around foreign blocking work. The model rides along only
-                # when resolved — legacy fakes/engines keep their signature.
+                # around foreign blocking work. The model/trace ride along
+                # only when resolved/supported — legacy fakes/engines keep
+                # their signature.
+                kw = {}
                 if t.model is not None:
-                    ef = r.engine.submit(t.op, t.row, k=t.k, seed=t.seed,
-                                         model=t.model)
-                else:
-                    ef = r.engine.submit(t.op, t.row, k=t.k, seed=t.seed)
-            except EngineOverloaded:
+                    kw["model"] = t.model
+                if t.span is not None and r.traces:
+                    kw["trace"] = t.span.ctx()
+                ef = r.engine.submit(t.op, t.row, k=t.k, seed=t.seed, **kw)
+            except EngineOverloaded as e:
                 any_shed = True
+                self._finish_span(t, e)
                 self._unplace(t, r)
                 exclude.add(r.index)
                 continue
-            except ValueError:
+            except ValueError as e:
+                self._finish_span(t, e)
                 self._unplace(t, r)
                 raise          # bad request: the engine's validation speaks
             except Exception as e:
+                self._finish_span(t, e)
                 self._unplace(t, r)
                 self._replica_failed(r, e)
                 exclude.add(r.index)
@@ -528,10 +583,16 @@ class ReplicaRouter:
                 self._publish_replica(r)
         exc = ef.exception()
         if exc is None:
+            if owns:
+                # the attempt that actually served the request closes its
+                # span; an abandoned dispatch's late success must not touch
+                # the live attempt's
+                self._finish_span(t)
             self._finalize(t, result=ef.result())
             return
         if not owns or t.finalized:
             return
+        self._finish_span(t, exc)
         if isinstance(exc, RequestTimeout):
             # the request's own deadline passed inside the replica: a typed
             # per-request outcome, not a replica failure — no reroute (its
@@ -568,6 +629,9 @@ class ReplicaRouter:
             self._count("replica_failures")
         for other in drained:
             self._count("reroutes")
+            # the failed replica's attempt dies with it — close its span
+            # (errored) before the reroute opens the next attempt's
+            self._finish_span(other, exc)
             self._redispatch(other, exclude={r.index})
 
     # -- health: stall detection + warm-probe re-admission ------------------
@@ -682,6 +746,8 @@ class ReplicaRouter:
                 r.outstanding.clear()
                 self._publish_replica(r)
         for t in leftovers:
-            self._finalize(t, exc=ReplicaUnavailable(
+            exc = ReplicaUnavailable(
                 "tier drained before the request completed (replica lost "
-                "mid-drain)"))
+                "mid-drain)")
+            self._finish_span(t, exc)
+            self._finalize(t, exc=exc)

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 from iwae_replication_project_tpu.serving.buckets import validate_k
@@ -54,13 +55,22 @@ class _Pending:
     """One in-flight request's per-row completion state (guarded by the
     owning connection's lock)."""
 
-    __slots__ = ("req_id", "results", "remaining", "error")
+    __slots__ = ("req_id", "results", "remaining", "error", "op", "model",
+                 "t_start", "span")
 
-    def __init__(self, req_id: Any, n_rows: int):
+    def __init__(self, req_id: Any, n_rows: int, op: Optional[str] = None,
+                 model: Optional[str] = None, t_start: float = 0.0,
+                 span=None):
         self.req_id = req_id
         self.results: List[Any] = [None] * n_rows
         self.remaining = n_rows
         self.error: Optional[BaseException] = None
+        # observability state: the request's op/model/admission time (SLO
+        # accounting) and its tier request span (trace tree root-or-child)
+        self.op = op
+        self.model = model
+        self.t_start = t_start
+        self.span = span
 
 
 class _Connection:
@@ -122,10 +132,16 @@ class _Connection:
             finished = pending.remaining == 0
         if not finished:
             return
+        code = None
         if pending.error is not None:
+            code = protocol.error_code_for(pending.error)
             self._respond_error(pending.req_id, pending.error)
         else:
             self._write(protocol.ok_response(pending.req_id, pending.results))
+        # observability AFTER the response write: the span's duration and
+        # the SLO latency both cover the full request, response included
+        self._tier.observe_request(pending.op, pending.model,
+                                   pending.t_start, code, pending.span)
         with self._lock:
             self._pending -= 1
             self._idle.notify_all()
@@ -139,10 +155,21 @@ class _Connection:
         req_id = obj.get("id")
         op = obj.get("op")
         if op in protocol.CONTROL_OPS:
-            doc = self._tier.info() if op == "info" else self._tier.stats()
+            doc = self._tier.info() if op == "info" else (
+                self._tier.stats() if op == "stats"
+                else self._tier.traces_doc(obj))
             self._write(protocol.ok_response(req_id, doc))
             return
+        t_start = self._tier.clock()
+        span = None
+        model = None
         try:
+            # trace context first: mint or accept (tier tracing on), but
+            # VALIDATE unconditionally — a malformed/oversized trace field
+            # is this request's typed bad_request whether or not the tier
+            # records traces, and the connection survives it either way
+            span = self._tier.open_request_span(obj.get("trace"), op,
+                                                t_start)
             rows = _payload_rows(obj)
             client = obj.get("client")
             if client is not None and not isinstance(client, str):
@@ -188,16 +215,29 @@ class _Connection:
                 if len(rows) != 1:
                     raise protocol.ProtocolError(
                         "'seed' applies to single-row payloads only")
+            if span is not None:
+                span.annotate(rows=len(rows), model=model,
+                              **({"k": k} if k is not None else {}))
+            t_admit = self._tier.clock()
             self._tier.admit(client, len(rows), model=model)
-            pending = _Pending(req_id, len(rows))
+            if span is not None:
+                # quota admission as a timed child span (pre-timed emit:
+                # zero tracing work inside the admission path itself)
+                from iwae_replication_project_tpu.telemetry.tracing import (
+                    emit_span)
+                emit_span(span.ctx(), "tier/admit", t_admit,
+                          self._tier.clock())
+            pending = _Pending(req_id, len(rows), op=op, model=model,
+                               t_start=t_start, span=span)
             with self._lock:
                 self._pending += 1
             futures = []
             try:
+                ctx = span.ctx() if span is not None else None
                 for row in rows:
                     futures.append(
                         self._tier.router.submit(op, row, k=k, seed=seed,
-                                                 model=model))
+                                                 model=model, trace=ctx))
             except Exception:
                 # partial admission: rows already routed complete and are
                 # discarded; the request as a unit gets the typed error —
@@ -213,6 +253,8 @@ class _Connection:
                     lambda fut, i=i, p=pending: self._row_done(p, i, fut))
         except Exception as e:
             self._respond_error(req_id, e)
+            self._tier.observe_request(op, model, t_start,
+                                       protocol.error_code_for(e), span)
 
     def serve(self) -> None:
         """The read loop (own daemon thread): handle lines until EOF or a
@@ -293,7 +335,8 @@ class ServingTier:
                  monitor_interval_s: float = 0.25,
                  large_k_threshold: Optional[int] = None,
                  shed_retry_after_s: float = 0.05,
-                 registry=None):
+                 registry=None, tracing: bool = True, recorder=None,
+                 slo=None):
         self.router = ReplicaRouter(
             engines, max_outstanding=max_outstanding,
             affinity_slack=affinity_slack,
@@ -303,6 +346,30 @@ class ServingTier:
         self.registry = self.router.registry
         self.quotas = ClientQuotas(quota)
         self._quota = quota
+        self.clock = time.monotonic
+        # request tracing (telemetry/tracing.py): ``tracing=True`` (the
+        # default) mints a trace per request — or joins one the client
+        # supplied — and lands completed trees in ``recorder`` (the
+        # process-default flight recorder unless injected). ``False``
+        # disables minting/recording; the ``trace`` field is still
+        # VALIDATED either way (protocol contract: malformed = typed
+        # bad_request, connection survives).
+        if tracing:
+            from iwae_replication_project_tpu.telemetry.tracing import (
+                get_recorder)
+            self.recorder = recorder if recorder is not None \
+                else get_recorder()
+        else:
+            self.recorder = None
+        # SLO burn-rate accounting (telemetry/slo.py): None = a default
+        # monitor on the router registry (its gauges share the fleet's
+        # Prometheus page); pass an SLOMonitor to set objectives, or
+        # ``False`` to disable
+        if slo is None:
+            from iwae_replication_project_tpu.telemetry.slo import SLOMonitor
+            self.slo: Optional[object] = SLOMonitor(registry=self.registry)
+        else:
+            self.slo = slo if slo is not False else None
         #: the ``retry_after_s`` hint stamped on ``overloaded`` responses
         #: that carry no exact wait of their own (queue-shed recovery time
         #: is unknowable server-side; this is the tier's suggested pause)
@@ -336,6 +403,67 @@ class ServingTier:
         (ceiling/shed/unavailable): the quota meters served work, so a
         request whose response is a typed routing error costs nothing."""
         self.quotas.refund(client, cost, model=model)
+
+    # -- observability (tracing + SLO) --------------------------------------
+
+    def open_request_span(self, trace_field, op, t_start: float):
+        """The request's ``tier/request`` span: minted fresh, or joined to
+        the wire ``trace`` context (fleet-of-fleets). Returns None when the
+        tier does not trace — but the field is VALIDATED regardless, so a
+        malformed trace is a typed ``bad_request`` on every tier."""
+        from iwae_replication_project_tpu.telemetry import tracing
+
+        trace_id = parent = None
+        if trace_field is not None:
+            try:
+                trace_id, parent = tracing.parse_wire_trace(trace_field)
+            except ValueError as e:
+                raise protocol.ProtocolError(str(e)) from None
+        if self.recorder is None:
+            return None
+        return tracing.start_span(
+            "tier/request", recorder=self.recorder, trace_id=trace_id,
+            parent_id=parent, t_start=t_start,
+            attrs={"op": op if isinstance(op, str) else repr(op)})
+
+    def observe_request(self, op, model, t_start: float,
+                        error_code: Optional[str], span) -> None:
+        """One finished (answered) request's observability fan-out: close
+        its tier span and account it against the (model, op) SLO.
+        ``bad_request`` traffic is traced but never SLO-observed — the
+        request is the client's fault, and a garbage op name must not mint
+        burn-rate gauges."""
+        if span is not None:
+            span.finish(error=error_code)
+        if self.slo is None or error_code == "bad_request":
+            return
+        if not isinstance(op, str) or not self.router.serves_op(op):
+            return
+        self.slo.observe(op, self.clock() - t_start, model=model,
+                         error_code=error_code)
+
+    def traces_doc(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """The ``{"op": "traces"}`` control response: the flight recorder's
+        retained traces (``limit``/``trace_id`` filters), as raw documents
+        (default) or one Chrome trace-event object (``format: "chrome"``).
+        A tier without tracing answers with empty state, not an error."""
+        from iwae_replication_project_tpu.telemetry.tracing import (
+            chrome_trace_events)
+
+        limit = obj.get("limit")
+        if not isinstance(limit, int) or isinstance(limit, bool):
+            limit = None
+        trace_id = obj.get("trace_id")
+        if not isinstance(trace_id, str):
+            trace_id = None
+        if self.recorder is None:
+            docs, stats = [], None
+        else:
+            docs = self.recorder.traces(limit=limit, trace_id=trace_id)
+            stats = self.recorder.stats()
+        if obj.get("format") == "chrome":
+            return chrome_trace_events(docs)
+        return {"stats": stats, "traces": docs}
 
     # -- info ---------------------------------------------------------------
 

@@ -47,7 +47,8 @@ class LatencyHistogram(Histogram):
 
     def summary(self) -> Dict[str, Optional[float]]:
         s = super().summary()
-        return {"count": s["count"], "mean_s": s["mean"], "p50_s": s["p50"],
+        return {"count": s["count"], "mean_s": s["mean"],
+                "total_s": s["total"], "p50_s": s["p50"],
                 "p95_s": s["p95"], "p99_s": s["p99"]}
 
 
@@ -134,9 +135,15 @@ class ServingMetrics:
         return f"{self.model}/{op}/b{bucket}" if self.model \
             else f"{op}/b{bucket}"
 
-    def record_latency(self, op: str, bucket: int, seconds: float) -> None:
+    def record_latency(self, op: str, bucket: int, seconds: float,
+                       trace_id: Optional[str] = None) -> None:
+        """Total observed latency; ``trace_id`` (a traced request) lands as
+        the latency bin's exemplar, so a quantile readout names a REAL
+        trace retrievable from the flight recorder (snapshot()'s
+        ``latency_exemplars`` section)."""
         self.registry.histogram(f"{_LAT}{self._hist_key(op, bucket)}",
-                                factory=LatencyHistogram).record(seconds)
+                                factory=LatencyHistogram).record(
+                                    seconds, exemplar=trace_id)
 
     def record_queue_wait(self, op: str, bucket: int, seconds: float) -> None:
         self.registry.histogram(f"{_QW}{self._hist_key(op, bucket)}",
@@ -167,6 +174,20 @@ class ServingMetrics:
 
         with self._kernel_lock:
             kernel = {key: dict(rec) for key, rec in self._kernel.items()}
+        # latency-quantile exemplars: per (op, bucket), the trace id of a
+        # request observed near the p50/p99 bins (None-free: keys appear
+        # only once an exemplar exists — untraced engines see no change)
+        exemplars: Dict[str, dict] = {}
+        for name in snap["histograms"]:
+            if not name.startswith(_LAT):
+                continue
+            h = self.registry.histogram(name, factory=LatencyHistogram)
+            ex = {q: h.exemplar_near(qv)
+                  for q, qv in (("p50", 0.50), ("p99", 0.99))}
+            if any(v is not None for v in ex.values()):
+                exemplars[name[len(_LAT):]] = {
+                    q: (v["label"] if v is not None else None)
+                    for q, v in ex.items()}
         # the process-wide executable-store section (capacity-bounded AOT
         # store, utils/compile_cache.py): one store serves every engine in
         # the process, so the numbers are global by design — stamped on
@@ -196,6 +217,7 @@ class ServingMetrics:
             "kernel": kernel,
             "padding_waste": (c["padded_rows"] / rows) if rows else 0.0,
             "latency": section(_LAT),
+            "latency_exemplars": exemplars,
             "queue_wait": section(_QW),
             "device_wait": section(_DW),
         }

@@ -165,6 +165,15 @@ class ShardedScoreEngine(ServingEngine):
         # not mint a metrics gauge per distinct k)
         return "dyn"
 
+    def _trace_attrs(self, op: str, k: int, bucket: int, n: int) -> dict:
+        # a traced large-k dispatch's span carries the streaming shape (the
+        # dynamic request k, the chunk it streams in, the mesh split) so a
+        # k=5000 p99 in the flight recorder attributes to blocks, not magic
+        attrs = super()._trace_attrs(op, k, bucket, n)
+        attrs.update({"sharded": True, "k_chunk": self.menu.k_chunk,
+                      "dp": self._dp})
+        return attrs
+
     def _dispatch_args(self, op: str, k: int, payload: np.ndarray,
                        seeds: np.ndarray) -> Tuple[tuple, dict, dict]:
         """Positional args of one sharded dispatch: payload/seed rows shard

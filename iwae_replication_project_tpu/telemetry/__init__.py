@@ -7,8 +7,15 @@ is imported explicitly by the call sites that compute diagnostics):
   process-default :class:`MetricRegistry` (:func:`get_registry`);
 * :mod:`.spans` — nested host-side :func:`span` timing that lands in the
   registry AND in ``jax.profiler`` traces under the same names;
-* :mod:`.exporters` — Prometheus text page + the ``/metrics`` HTTP endpoint
-  (JSONL/TensorBoard export rides :class:`~..utils.logging.MetricsLogger`);
+* :mod:`.exporters` — Prometheus text page + the ``/metrics`` and
+  ``/traces`` HTTP endpoints (JSONL/TensorBoard export rides
+  :class:`~..utils.logging.MetricsLogger`);
+* :mod:`.tracing` — per-request trace trees: explicit
+  :class:`TraceContext` threading, a tail-sampled
+  :class:`FlightRecorder` (:func:`get_recorder`), Chrome trace-event
+  export;
+* :mod:`.slo` — :class:`SLOMonitor`: per-(model, op) latency/availability
+  objectives published as multi-window burn-rate gauges;
 * :mod:`.diagnostics` — :class:`DiagnosticsConfig`-gated ESS / log-weight
   variance / gradient-SNR / active-units reductions that run inside the
   jitted train/eval programs.
@@ -25,14 +32,26 @@ from iwae_replication_project_tpu.telemetry.registry import (
     MetricRegistry,
     get_registry,
 )
+from iwae_replication_project_tpu.telemetry.slo import (
+    SLOMonitor,
+    SLOObjective,
+)
 from iwae_replication_project_tpu.telemetry.spans import (
     current_span,
     span,
     spanned,
+)
+from iwae_replication_project_tpu.telemetry.tracing import (
+    FlightRecorder,
+    TraceContext,
+    chrome_trace_events,
+    get_recorder,
 )
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricRegistry", "get_registry",
     "current_span", "span", "spanned",
     "prometheus_text", "start_metrics_server",
+    "FlightRecorder", "TraceContext", "chrome_trace_events", "get_recorder",
+    "SLOMonitor", "SLOObjective",
 ]

@@ -7,10 +7,16 @@ per-stage rows ride. This module adds the pull-based surface:
 
 * :func:`prometheus_text` — the registry as a Prometheus text-format page
   (counters as ``*_total``, gauges, histograms as summaries with quantile
-  labels);
-* :func:`start_metrics_server` — a daemon-thread HTTP endpoint serving that
-  page at ``/metrics``, which the ``iwae-serve`` CLI exposes via
-  ``--metrics-port``.
+  labels), with ``# HELP`` lines per family and the histogram ``_sum``
+  taken from the Histogram's exact tracked ``total``.  Same-name
+  collisions across merged registries stay last-writer-wins (the
+  documented merge order) but are COUNTED on the process registry's
+  ``telemetry/export_collisions`` counter instead of passing silently;
+* :func:`start_metrics_server` — a daemon-thread HTTP endpoint serving
+  that page at ``/metrics`` — plus, when handed a flight recorder
+  (telemetry/tracing.py), the retained request traces as Chrome
+  trace-event JSON at ``/traces`` — which the ``iwae-serve`` CLI exposes
+  via ``--metrics-port``.
 
 Dependency-free (stdlib http.server); the server snapshots the registry per
 request, so a long-lived scrape always sees current values.
@@ -32,6 +38,37 @@ _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 _QUANTILES = (("p50", "0.5"), ("p95", "0.95"), ("p99", "0.99"))
 
 
+#: name-prefix -> # HELP text (first match wins; anything unlisted gets a
+#: generic line naming the original slash-path)
+_HELP_PREFIXES = (
+    ("latency/", "per-request serving latency in seconds, by "
+                 "(model, op, bucket)"),
+    ("queue_wait/", "submit-to-device-enqueue wait in seconds "
+                    "(coalescing + in-flight backpressure)"),
+    ("device_wait/", "device-enqueue-to-fetch wait in seconds "
+                     "(compute + D2H)"),
+    ("router/", "serving-tier replica router accounting"),
+    ("slo/", "SLO burn-rate accounting: violation fraction over the "
+             "trailing window divided by the error budget (1 - target)"),
+    ("span/", "host-side span wall time in seconds (telemetry/spans.py)"),
+    ("store/", "process executable-store accounting "
+               "(utils/compile_cache.py)"),
+    ("kernel/", "hot-loop path selected per (op, bucket, k) dispatch "
+                "config (ops/hot_loop.PATH_CODES)"),
+    ("autotune/", "tile/remat autotuner accounting (ops/autotune.py)"),
+    ("telemetry/", "telemetry-pipeline self-accounting"),
+    ("diag/", "on-device estimator diagnostics "
+              "(telemetry/diagnostics.py)"),
+)
+
+
+def _help_for(name: str, kind: str) -> str:
+    for prefix, text in _HELP_PREFIXES:
+        if name.startswith(prefix):
+            return text
+    return f"iwae {kind} {name!r}"
+
+
 def _sanitize(name: str) -> str:
     n = _NAME_RE.sub("_", name)
     return n if not n[:1].isdigit() else "_" + n
@@ -48,27 +85,42 @@ def _fmt(v) -> str:
 def prometheus_text(registries, namespace: str = "iwae") -> str:
     """Render one or more registries as a Prometheus exposition page.
 
-    Later registries win on (sanitized) name collisions — pass the
-    process-default registry first and subsystem registries after it.
+    Later registries win on name collisions — pass the process-default
+    registry first and subsystem registries after it.  Every collision is
+    counted on the process registry's ``telemetry/export_collisions``
+    counter (visible from the NEXT scrape), so a shadowed metric is a
+    visible condition instead of a silently wrong dashboard.
     """
     if isinstance(registries, MetricRegistry):
         registries = (registries,)
     counters, gauges, hists = {}, {}, {}
+    collisions = 0
     for reg in registries:
         snap = reg.snapshot()
-        counters.update(snap["counters"])
-        gauges.update(snap["gauges"])
-        hists.update(snap["histograms"])
+        for src, dst in ((snap["counters"], counters),
+                         (snap["gauges"], gauges),
+                         (snap["histograms"], hists)):
+            for k, v in src.items():
+                if k in dst:
+                    collisions += 1
+                dst[k] = v
+    if collisions:
+        from iwae_replication_project_tpu.telemetry.registry import (
+            get_registry)
+        get_registry().counter("telemetry/export_collisions").inc(collisions)
 
     lines = []
     for name, v in sorted(counters.items()):
         m = f"{namespace}_{_sanitize(name)}_total"
-        lines += [f"# TYPE {m} counter", f"{m} {_fmt(v)}"]
+        lines += [f"# HELP {m} {_help_for(name, 'counter')}",
+                  f"# TYPE {m} counter", f"{m} {_fmt(v)}"]
     for name, v in sorted(gauges.items()):
         m = f"{namespace}_{_sanitize(name)}"
-        lines += [f"# TYPE {m} gauge", f"{m} {_fmt(v)}"]
+        lines += [f"# HELP {m} {_help_for(name, 'gauge')}",
+                  f"# TYPE {m} gauge", f"{m} {_fmt(v)}"]
     for name, s in sorted(hists.items()):
         m = f"{namespace}_{_sanitize(name)}"
+        lines.append(f"# HELP {m} {_help_for(name, 'summary')}")
         lines.append(f"# TYPE {m} summary")
         for key, label in _QUANTILES:
             v = next((s[k] for k in (key, key + "_s") if s.get(k) is not None),
@@ -76,25 +128,56 @@ def prometheus_text(registries, namespace: str = "iwae") -> str:
             if v is not None:
                 lines.append(f'{m}{{quantile="{label}"}} {_fmt(v)}')
         count = s.get("count") or 0
-        mean = next((s[k] for k in ("mean", "mean_s")
-                     if s.get(k) is not None), None)
         lines.append(f"{m}_count {_fmt(count)}")
-        if mean is not None:
-            lines.append(f"{m}_sum {_fmt(mean * count)}")
+        # _sum from the histogram's exact tracked total; the mean * count
+        # reconstruction (pre-satellite behavior) only as a fallback for
+        # foreign summaries that never carried one
+        total = next((s[k] for k in ("total", "total_s")
+                      if s.get(k) is not None), None)
+        if total is None:
+            mean = next((s[k] for k in ("mean", "mean_s")
+                         if s.get(k) is not None), None)
+            total = mean * count if mean is not None else None
+        if total is not None:
+            lines.append(f"{m}_sum {_fmt(total)}")
     return "\n".join(lines) + "\n"
 
 
 class _MetricsHandler(BaseHTTPRequestHandler):
     registries: Sequence[MetricRegistry] = ()
+    recorder = None     # optional FlightRecorder backing /traces
 
     def do_GET(self):  # noqa: N802 (http.server API)
-        if self.path.split("?")[0] not in ("/", "/metrics"):
+        path = self.path.split("?")[0]
+        if path == "/traces":
+            self._serve_traces()
+            return
+        if path not in ("/", "/metrics"):
             self.send_error(404)
             return
         body = prometheus_text(self.registries).encode()
         self.send_response(200)
         self.send_header("Content-Type",
                          "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _serve_traces(self):
+        """Retained flight-recorder traces as Chrome trace-event JSON —
+        save the response body and load it in chrome://tracing/Perfetto
+        (the ``iwae-trace`` CLI does the same over the wire op)."""
+        if self.recorder is None:
+            self.send_error(404, "tracing is not enabled on this server")
+            return
+        import json
+
+        from iwae_replication_project_tpu.telemetry.tracing import (
+            chrome_trace_events)
+        body = json.dumps(
+            chrome_trace_events(self.recorder.traces())).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json; charset=utf-8")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -113,10 +196,13 @@ class _MetricsServer(ThreadingHTTPServer):
 
 
 def start_metrics_server(registries, port: int,
-                         host: str = "127.0.0.1") -> ThreadingHTTPServer:
+                         host: str = "127.0.0.1",
+                         recorder=None) -> ThreadingHTTPServer:
     """Serve ``/metrics`` in a daemon thread; returns the live server
     (``.server_address[1]`` is the bound port — pass ``port=0`` for an
-    ephemeral one; ``.shutdown()`` stops it and releases the port)."""
+    ephemeral one; ``.shutdown()`` stops it and releases the port).
+    ``recorder`` (a :class:`~.tracing.FlightRecorder`) additionally serves
+    its retained traces as Chrome trace-event JSON at ``/traces``."""
     if isinstance(registries, MetricRegistry):
         registries = (registries,)
 
@@ -124,6 +210,7 @@ def start_metrics_server(registries, port: int,
         pass
 
     Handler.registries = tuple(registries)
+    Handler.recorder = recorder
     srv = _MetricsServer((host, port), Handler)
     threading.Thread(target=srv.serve_forever, name="iwae-metrics-http",
                      daemon=True).start()

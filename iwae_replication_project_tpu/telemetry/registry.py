@@ -89,7 +89,7 @@ class Histogram:
     """
 
     __slots__ = ("_lock", "lo", "bins_per_decade", "counts", "n", "total",
-                 "vmax")
+                 "vmax", "exemplars")
 
     def __init__(self, lock: Optional[threading.Lock] = None,
                  lo: float = HIST_LO, bins_per_decade: int = BINS_PER_DECADE,
@@ -103,6 +103,11 @@ class Histogram:
         self.vmax = 0.0  # exact observed max: clamps the percentile upper
         #                  bounds (a quantile can never exceed the max, and
         #                  the overflow bin's nominal bound is meaningless)
+        #: per-bin last (value, label) exemplar — e.g. the trace id of a
+        #: request observed in that latency bin, so a quantile readout can
+        #: name a REAL trace in the flight recorder. Lazily allocated: a
+        #: histogram never fed exemplars pays nothing.
+        self.exemplars: Optional[Dict[int, tuple]] = None
 
     def _bin_index(self, v: float) -> int:
         if v <= self.lo:
@@ -113,19 +118,44 @@ class Histogram:
     def _bin_upper(self, i: int) -> float:
         return self.lo * 10.0 ** ((i + 1) / self.bins_per_decade)
 
-    def record(self, v: float) -> None:
+    def record(self, v: float, exemplar=None) -> None:
         with self._lock:
-            self.counts[self._bin_index(v)] += 1
+            i = self._bin_index(v)
+            self.counts[i] += 1
             self.n += 1
             self.total += v
             if v > self.vmax:
                 self.vmax = v
+            if exemplar is not None:
+                if self.exemplars is None:
+                    self.exemplars = {}
+                self.exemplars[i] = (v, exemplar)
 
     def percentile(self, q: float) -> Optional[float]:
         """Upper bound of the bin holding the q-quantile (q in [0, 1]),
         clamped by the exact observed max."""
         with self._lock:
             return self._percentile(q)
+
+    def exemplar_near(self, q: float) -> Optional[Dict[str, object]]:
+        """``{"value", "label"}`` of the exemplar nearest the q-quantile's
+        bin (ties resolve downward), or None when no exemplars were ever
+        recorded — the quantile -> real-trace link the flight recorder
+        resolves."""
+        with self._lock:
+            if not self.exemplars or self.n == 0:
+                return None
+            target = q * self.n
+            seen = 0
+            qi = len(self.counts) - 1
+            for i, c in enumerate(self.counts):
+                seen += c
+                if seen >= target:
+                    qi = i
+                    break
+            best = min(self.exemplars, key=lambda b: (abs(b - qi), b))
+            v, label = self.exemplars[best]
+            return {"value": v, "label": label}
 
     def _percentile(self, q: float) -> Optional[float]:
         # caller holds self._lock: counts/n/vmax are read as one consistent
@@ -144,6 +174,10 @@ class Histogram:
         with self._lock:  # one consistent view across count/mean/percentiles
             mean = self.total / self.n if self.n else None
             return {"count": self.n, "mean": mean,
+                    # the exact running sum: exporters emit it verbatim as
+                    # the Prometheus `_sum` instead of reconstructing
+                    # mean * count (which re-rounds what we already track)
+                    "total": self.total if self.n else None,
                     "p50": self._percentile(0.50),
                     "p95": self._percentile(0.95),
                     "p99": self._percentile(0.99),

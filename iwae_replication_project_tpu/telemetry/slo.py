@@ -1,0 +1,225 @@
+"""Service-level objectives: per-(model, op) burn-rate gauges.
+
+The elastic-fleet control loop (ROADMAP item 2) needs one signal above raw
+latency histograms: *how fast is each (model, op) burning its error
+budget?*  This module declares latency/availability objectives and
+publishes multi-window burn rates as ordinary registry gauges, so they
+ride the same Prometheus page as everything else — the admission signal an
+autoscaler consumes.
+
+Definitions (the standard SRE accounting):
+
+* a request is a **latency violation** when it errored or took longer than
+  the objective's ``latency_s`` (an errored request is not a fast good
+  response);
+* a request is an **availability violation** when its typed error code is
+  server-attributable (``DEFAULT_ERROR_CODES``: internal / timeout /
+  unavailable / overloaded).  ``bad_request`` and ``quota_exceeded`` are
+  the client's doing and never burn the server's budget (the front end
+  does not even observe ``bad_request`` traffic — a garbage op name must
+  not mint gauges);
+* **burn rate** over a window = observed violation fraction ÷ allowed
+  violation fraction (``1 - target``).  1.0 means the budget burns exactly
+  as fast as it refills; a fast-window burn ≫ 1 with the slow window
+  confirming is the page/scale-up signal.
+
+Windows are bucketed rings (``buckets`` slots per window, advanced by the
+injectable clock), so ``observe`` is O(1) amortized and the gauges read
+the trailing window, not process-lifetime averages.
+
+Published instruments, per key (``<model>/<op>``, or ``<op>`` for the
+unlabeled single-model tier):
+
+* gauges ``slo/<key>/latency_burn_<win>`` and
+  ``slo/<key>/availability_burn_<win>`` for every window (default ``5m``
+  and ``1h``);
+* counters ``slo/<key>/requests``, ``slo/<key>/latency_violations``,
+  ``slo/<key>/errors``.
+
+Schema pinned in tests/test_telemetry.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+from iwae_replication_project_tpu.telemetry.registry import MetricRegistry
+
+__all__ = ["SLOObjective", "SLOMonitor", "DEFAULT_ERROR_CODES",
+           "DEFAULT_WINDOWS"]
+
+#: typed protocol codes that count against the availability objective —
+#: the server-attributable half of protocol.ERROR_CODES
+DEFAULT_ERROR_CODES = frozenset(
+    {"internal", "timeout", "unavailable", "overloaded"})
+
+#: (window seconds, gauge label): the classic fast/slow multi-window pair
+DEFAULT_WINDOWS: Tuple[Tuple[float, str], ...] = ((300.0, "5m"),
+                                                  (3600.0, "1h"))
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOObjective:
+    """One (model, op)'s objectives (frozen: share across threads).
+
+    ``latency_s`` is the per-request threshold, ``latency_target`` the
+    fraction of requests that must beat it, ``availability_target`` the
+    fraction that must not error."""
+
+    latency_s: float = 0.5
+    latency_target: float = 0.99
+    availability_target: float = 0.999
+
+    def __post_init__(self):
+        if self.latency_s <= 0:
+            raise ValueError(f"latency_s must be > 0, got {self.latency_s}")
+        for name in ("latency_target", "availability_target"):
+            v = getattr(self, name)
+            if not 0.0 < v < 1.0:
+                raise ValueError(f"{name} must be in (0, 1), got {v} — a "
+                                 f"target of 1.0 makes every burn rate "
+                                 f"infinite")
+
+
+class _Ring:
+    """One bucketed sliding window of (total, latency-bad, error-bad)
+    counts.  No lock of its own: the owning monitor's lock guards it."""
+
+    __slots__ = ("width_s", "total", "bad_lat", "bad_err", "epoch")
+
+    def __init__(self, window_s: float, buckets: int):
+        self.width_s = window_s / buckets
+        self.total = [0] * buckets
+        self.bad_lat = [0] * buckets
+        self.bad_err = [0] * buckets
+        self.epoch: Optional[int] = None   # absolute index of current slot
+
+    def _advance(self, now: float) -> None:
+        e = int(now / self.width_s)
+        n = len(self.total)
+        if self.epoch is None:
+            self.epoch = e
+            return
+        step = min(e - self.epoch, n)
+        for j in range(1, step + 1):
+            i = (self.epoch + j) % n
+            self.total[i] = self.bad_lat[i] = self.bad_err[i] = 0
+        if e > self.epoch:
+            self.epoch = e
+
+    def observe(self, now: float, lat_bad: bool, err_bad: bool) -> None:
+        self._advance(now)
+        i = self.epoch % len(self.total)
+        self.total[i] += 1
+        self.bad_lat[i] += lat_bad
+        self.bad_err[i] += err_bad
+
+    def fractions(self, now: float) -> Tuple[float, float, int]:
+        self._advance(now)
+        t = sum(self.total)
+        if not t:
+            return 0.0, 0.0, 0
+        return sum(self.bad_lat) / t, sum(self.bad_err) / t, t
+
+
+class SLOMonitor:
+    """Observe request outcomes; publish burn-rate gauges per (model, op).
+
+    ``objectives`` maps ``(model, op)`` (model ``None`` = the unlabeled
+    lane) to :class:`SLOObjective`; anything unlisted uses ``default``.
+    ``registry`` is where the gauges/counters land — the serving tier
+    passes its router registry so the burn rates share the fleet's
+    Prometheus page.  The clock is injectable (tests drive window
+    rotation with a fake clock, like quotas and the batcher)."""
+
+    def __init__(self, registry: Optional[MetricRegistry] = None,
+                 default: SLOObjective = SLOObjective(),
+                 objectives: Optional[Dict[Tuple[Optional[str], str],
+                                           SLOObjective]] = None,
+                 windows: Sequence[Tuple[float, str]] = DEFAULT_WINDOWS,
+                 buckets_per_window: int = 30,
+                 error_codes: frozenset = DEFAULT_ERROR_CODES,
+                 clock: Callable[[], float] = time.monotonic):
+        if not windows:
+            raise ValueError("at least one burn-rate window is required")
+        self.registry = registry if registry is not None else MetricRegistry()
+        self.default = default
+        self.objectives = dict(objectives) if objectives else {}
+        self.windows = tuple((float(w), str(label)) for w, label in windows)
+        self.error_codes = frozenset(error_codes)
+        self._buckets = int(buckets_per_window)
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: key -> [one _Ring per window]; guarded by _lock
+        self._rings: Dict[str, list] = {}
+
+    @staticmethod
+    def key_for(model: Optional[str], op: str) -> str:
+        """The gauge-name key (mirrors ServingMetrics' histogram keys)."""
+        return f"{model}/{op}" if model else op
+
+    def objective_for(self, model: Optional[str], op: str) -> SLOObjective:
+        obj = self.objectives.get((model, op))
+        if obj is None and model is not None:
+            obj = self.objectives.get((None, op))   # op-wide fallback
+        return obj if obj is not None else self.default
+
+    def observe(self, op: str, latency_s: float, *,
+                model: Optional[str] = None,
+                error_code: Optional[str] = None) -> None:
+        """Account one finished request and republish its key's gauges."""
+        obj = self.objective_for(model, op)
+        err_bad = error_code is not None and error_code in self.error_codes
+        lat_bad = err_bad or latency_s > obj.latency_s
+        key = self.key_for(model, op)
+        now = self._clock()
+        with self._lock:
+            rings = self._rings.get(key)
+            if rings is None:
+                rings = self._rings[key] = [
+                    _Ring(w, self._buckets) for w, _ in self.windows]
+            fracs = []
+            for ring in rings:
+                ring.observe(now, lat_bad, err_bad)
+                fracs.append(ring.fractions(now))
+        # publish OUTSIDE the monitor lock: the registry has its own lock
+        # and the lock graph stays a tree by construction
+        for (_, label), (lat_frac, err_frac, _n) in zip(self.windows, fracs):
+            self.registry.gauge(f"slo/{key}/latency_burn_{label}").set(
+                lat_frac / (1.0 - obj.latency_target))
+            self.registry.gauge(f"slo/{key}/availability_burn_{label}").set(
+                err_frac / (1.0 - obj.availability_target))
+        self.registry.counter(f"slo/{key}/requests").inc()
+        if lat_bad:
+            self.registry.counter(f"slo/{key}/latency_violations").inc()
+        if err_bad:
+            self.registry.counter(f"slo/{key}/errors").inc()
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Current burn rates per key (the wire/bench-facing document;
+        schema pinned in tests/test_telemetry.py)."""
+        now = self._clock()
+        with self._lock:
+            keys = {key: [r.fractions(now) for r in rings]
+                    for key, rings in self._rings.items()}
+        out: Dict[str, dict] = {}
+        for key, fracs in keys.items():
+            model, _, op = key.rpartition("/")
+            obj = self.objective_for(model or None, op or key)
+            wins = {}
+            for (_, label), (lat_frac, err_frac, n) in zip(self.windows,
+                                                           fracs):
+                wins[label] = {
+                    "requests": n,
+                    "latency_burn": lat_frac / (1.0 - obj.latency_target),
+                    "availability_burn":
+                        err_frac / (1.0 - obj.availability_target),
+                }
+            out[key] = {
+                "objective": dataclasses.asdict(obj),
+                "windows": wins,
+            }
+        return out

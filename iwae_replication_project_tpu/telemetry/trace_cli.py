@@ -1,0 +1,88 @@
+"""``iwae-trace``: dump a serving tier's flight recorder.
+
+A pure socket client (no jax, no device — like ``iwae-serve --client``):
+connects to a running tier, issues the ``traces`` control op
+(serving/frontend/protocol.py), and writes the result as Chrome
+trace-event JSON (load the file in ``chrome://tracing`` or Perfetto) or
+as the raw trace documents.
+
+Examples::
+
+    iwae-trace 127.0.0.1:7777 --out traces.json     # chrome format
+    iwae-trace 127.0.0.1:7777 --raw --limit 8       # raw docs, stdout
+    iwae-trace 127.0.0.1:7777 --stats               # recorder accounting
+    iwae-trace 127.0.0.1:7777 --trace-id ab12...    # one trace by id
+
+The same data is served over HTTP at ``/traces`` when the tier runs with
+``--metrics-port`` — this CLI exists for tiers without the metrics server
+and for piping into files/jq.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="iwae-trace",
+        description="dump a serving tier's tail-sampled request traces "
+                    "as Chrome trace-event JSON")
+    ap.add_argument("target", metavar="HOST:PORT",
+                    help="a running iwae-serve tier's TCP endpoint")
+    ap.add_argument("--out", type=str, default=None,
+                    help="write here instead of stdout")
+    ap.add_argument("--raw", action="store_true",
+                    help="raw flight-recorder trace documents (+ stats) "
+                         "instead of Chrome trace-event JSON")
+    ap.add_argument("--stats", action="store_true",
+                    help="recorder accounting only (kept/dropped/ring "
+                         "occupancy), no trace bodies")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="most recent N traces only")
+    ap.add_argument("--trace-id", dest="trace_id", type=str, default=None,
+                    help="one trace by id (e.g. from a latency exemplar)")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    from iwae_replication_project_tpu.serving.frontend.client import (
+        TierClient, TierError)
+
+    host, _, port = args.target.rpartition(":")
+    try:
+        cli = TierClient(host or "127.0.0.1", int(port))
+    except (OSError, ValueError) as e:
+        print(f"iwae-trace: cannot reach tier at {args.target!r}: {e}",
+              file=sys.stderr)
+        return 2
+    try:
+        if args.stats:
+            doc = cli.traces(limit=0)["stats"]
+        else:
+            doc = cli.traces(limit=args.limit, trace_id=args.trace_id,
+                             fmt=None if args.raw else "chrome")
+    except TierError as e:
+        print(f"iwae-trace: tier rejected the traces op: {e}",
+              file=sys.stderr)
+        return 2
+    finally:
+        cli.close()
+    text = json.dumps(doc, indent=2)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+        n = len(doc.get("traceEvents", doc.get("traces", []))) \
+            if isinstance(doc, dict) else 0
+        print(f"iwae-trace: wrote {args.out} ({n} "
+              f"{'events' if not args.raw else 'traces'})")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,7 +42,14 @@ machine-readable summary.
    response bitwise-correct vs dedicated single-model engines, zero
    fresh compiles once warm (evictions demote to the persistent cache
    and readmit by deserialization);
-12. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
+12. **trace smoke** (scripts/trace_smoke.py) — end-to-end request tracing
+   over a real socket: a ragged burst with a replica killed mid-burst
+   plus a hedged request, every request yielding ONE coherent trace tree
+   (client -> tier -> router attempts -> engine stages) in the
+   tail-sampled flight recorder, results bitwise identical to a
+   tracing-off tier, the ``traces`` wire op valid in raw and Chrome
+   formats, and SLO burn-rate gauges live on the Prometheus page;
+13. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
    ``--sanitize`` armed.
 
 Every full-gate run writes ``results/check_summary.json`` (per-stage status,
@@ -212,6 +219,12 @@ def run_multi_model_smoke() -> dict:
                                                   "multi_model_smoke.py")])
 
 
+def run_trace_smoke() -> dict:
+    return run_step("trace smoke",
+                    [sys.executable, os.path.join("scripts",
+                                                  "trace_smoke.py")])
+
+
 def run_tests(extra) -> dict:
     return run_step("tier-1 tests", [
         sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
@@ -258,6 +271,7 @@ def main(argv=None) -> int:
         stages.append(run_autotune_smoke())
         stages.append(run_chaos_smoke())
         stages.append(run_multi_model_smoke())
+        stages.append(run_trace_smoke())
     if not args.lint_only:
         stages.append(run_tests(passthrough))
 

@@ -1,0 +1,314 @@
+"""End-to-end tracing smoke stage for scripts/check.py.
+
+One short CPU process proving the observability tentpole's two hard
+contracts with REAL engines, a REAL socket, and real injected faults:
+
+1. **one coherent trace tree per request** — a ragged burst through the
+   TCP front end with (a) a replica killed mid-burst holding work (router
+   reroute: the victim's attempt span closes errored, attempt-2 serves)
+   and (b) a tail-latency hedge (a chaos-stalled replica beaten by the
+   client's second connection): every request's retained trace has exactly
+   one root, every parent id resolves inside the tree, and the tree spans
+   client -> tier -> router attempt(s) -> engine pipeline stages;
+2. **bitwise parity vs tracing-off** — the identical burst through a
+   tracing-off tier returns bit-identical results: tracing is host-side
+   metadata only, it never touches seeds, payloads, or program shapes
+   (the kill is applied only on the traced run — reroutes re-serve with
+   original seeds, so even the fault is invisible in the bits).
+
+Also exercises the wire surface (the ``traces`` control op in raw and
+Chrome formats) and pins the SLO burn-rate gauges on the tier's
+Prometheus page.  Same deliberately tiny architecture as the other
+serving smokes: this checks observability plumbing, not throughput —
+``bench.py --tracing`` owns the overhead numbers.
+
+Exit 0 on success, 1 with a message on the first failed check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class KillableReplica:
+    """Engine proxy with an induced-death switch (the reroute fault
+    injector, as in serving_tier_smoke.py) — trace-capable, so the router
+    forwards contexts and the engine's stage spans land in the tree."""
+
+    traces = True
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.row_dims = engine.row_dims
+        self.k = engine.k
+        self._lock = threading.Lock()
+        self._live = []
+        self.killed = False
+        self.submitted = 0
+
+    def submit(self, op, row, k=None, *, seed=None, trace=None):
+        with self._lock:
+            if self.killed:
+                raise RuntimeError("replica killed (smoke fault injection)")
+        f = self.engine.submit(op, row, k=k, seed=seed, trace=trace)
+        with self._lock:
+            self._live.append(f)
+            self.submitted += 1
+        return f
+
+    def kill(self):
+        with self._lock:
+            self.killed = True
+            live, self._live = self._live, []
+        for f in live:
+            try:
+                f.set_exception(
+                    RuntimeError("replica killed (smoke fault injection)"))
+            except Exception:
+                pass        # already completed: nothing in flight to lose
+
+    def revive(self):
+        """Clear the death switch: the router's warm probe re-admits."""
+        with self._lock:
+            self.killed = False
+
+    def start(self):
+        self.engine.start()
+
+    def stop(self, timeout_s=60.0):
+        self.engine.stop()
+
+    def warmup(self, ops=(), ks=None):
+        return self.engine.warmup(ops=tuple(ops), ks=ks)
+
+
+def _tree_check(doc, label):
+    """One coherent tree: a single root, every parent resolves locally."""
+    ids = {s["span_id"] for s in doc["spans"]}
+    roots = [s for s in doc["spans"]
+             if s["parent_id"] is None or s["parent_id"] not in ids]
+    assert len(roots) == 1, \
+        f"{label}: trace {doc['trace_id']} has {len(roots)} roots " \
+        f"({[r['name'] for r in roots]})"
+    return roots[0], {s["name"] for s in doc["spans"]}
+
+
+def _wait_for_traces(recorder, trace_ids, deadline_s=20.0):
+    deadline = time.monotonic() + deadline_s
+    want = set(trace_ids)
+    while time.monotonic() < deadline:
+        have = {d["trace_id"] for d in recorder.traces()}
+        if want <= have:
+            return {d["trace_id"]: d for d in recorder.traces()
+                    if d["trace_id"] in want}
+        time.sleep(0.02)
+    have = {d["trace_id"] for d in recorder.traces()}
+    raise AssertionError(
+        f"traces never finalized: missing {sorted(want - have)[:3]} "
+        f"(recorder stats: {recorder.stats()})")
+
+
+def main() -> int:
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        setup_persistent_cache)
+
+    # warm-path discipline, like every entry point: repeated CI runs
+    # deserialize the serving programs instead of recompiling them
+    setup_persistent_cache(base_dir=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+    import numpy as np
+
+    from iwae_replication_project_tpu.models import iwae as model
+    from iwae_replication_project_tpu.serving import ServingEngine
+    from iwae_replication_project_tpu.serving import faults
+    from iwae_replication_project_tpu.serving.frontend import (
+        RetryPolicy, ServingTier, TierClient)
+    from iwae_replication_project_tpu.telemetry import prometheus_text
+    from iwae_replication_project_tpu.telemetry.tracing import (
+        FlightRecorder, start_span)
+
+    D = 32
+    cfg = model.ModelConfig(x_dim=D, n_hidden_enc=(16, 8), n_latent_enc=(8, 4),
+                            n_hidden_dec=(8, 16), n_latent_dec=(8, D))
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+
+    def engine():
+        return ServingEngine(params=params, model_config=cfg, k=4,
+                             max_batch=8, max_inflight=2, timeout_s=30.0)
+
+    rng = np.random.RandomState(0)
+    sizes = (1, 3, 7, 2, 8, 5, 1, 4, 6, 2)
+    x = (rng.rand(sum(sizes), D) > 0.5).astype(np.float32)
+
+    # -- reference: the SAME burst through a tracing-OFF tier ---------------
+    ref_tier = ServingTier([engine(), engine()], port=0, tracing=False)
+    ref_tier.warmup(ops=("score",))
+    ref_tier.start()
+    with TierClient("127.0.0.1", ref_tier.port) as cli:
+        ids, off = [], 0
+        for n in sizes:
+            ids.append(cli.submit("score", x[off:off + n].tolist()))
+            off += n
+        ref_resp = cli.drain(ids)
+        ref = [ref_resp[rid]["result"] for rid in ids]
+        assert all(ref_resp[rid]["ok"] for rid in ids), "reference burst failed"
+    ref_tier.stop(timeout_s=30)
+
+    # -- traced run: keep EVERY trace (sample_every=1), kill mid-burst ------
+    rec = FlightRecorder(capacity=512, sample_every=1)
+    victim = KillableReplica(engine())
+    # affinity_slack=0: the hedge below must land on the OTHER replica
+    # (strict least-inflight), not ride bucket affinity onto the stalled one
+    tier = ServingTier([victim, engine()], port=0, monitor_interval_s=0.05,
+                       affinity_slack=0, recorder=rec)
+    assert tier.recorder is rec and tier.slo is not None
+    tier.warmup(ops=("score",))
+    tier.start()
+
+    burst_tids = []
+    with TierClient("127.0.0.1", tier.port, trace=True, recorder=rec) as cli:
+        spans, ids, off = [], [], 0
+        for i, n in enumerate(sizes):
+            # explicit per-request root spans so the smoke knows each
+            # request's trace id (the auto-mint path is equivalent)
+            sp = start_span("client/request", recorder=rec,
+                            attrs={"op": "score", "req": i})
+            spans.append(sp)
+            burst_tids.append(sp.trace_id)
+            ids.append(cli.submit("score", x[off:off + n].tolist(),
+                                  trace=sp.ctx()))
+            off += n
+            if i == len(sizes) // 2:
+                deadline = time.monotonic() + 10.0
+                while victim.submitted == 0:
+                    assert time.monotonic() < deadline, \
+                        "victim replica never received work"
+                    time.sleep(0.002)
+                victim.kill()
+        responses = cli.drain(ids)
+        for sp, rid in zip(spans, ids):
+            sp.finish(error=None if responses[rid]["ok"]
+                      else responses[rid].get("error"))
+        assert all(responses[rid]["ok"] for rid in ids), \
+            f"traced burst failed: " \
+            f"{[responses[rid] for rid in ids if not responses[rid]['ok']][:2]}"
+        out = [responses[rid]["result"] for rid in ids]
+
+        # bitwise parity: tracing (and the kill it wrapped) is invisible
+        assert out == ref, \
+            "traced-run results differ from the tracing-off reference"
+
+        # -- every burst request: one coherent tree, all layers present ----
+        docs = _wait_for_traces(rec, burst_tids)
+        rerouted = 0
+        for tid in burst_tids:
+            root, names = _tree_check(docs[tid], "burst")
+            assert root["name"] == "client/request", root
+            for need in ("tier/request", "tier/admit", "router/attempt-1",
+                         "engine/queue", "engine/pad", "engine/dispatch",
+                         "engine/fetch"):
+                assert need in names, \
+                    f"trace {tid} missing {need}: {sorted(names)}"
+            if "router/attempt-2" in names:
+                errored = [s for s in docs[tid]["spans"]
+                           if s["name"] == "router/attempt-1"
+                           and s["error"] is not None]
+                assert errored, \
+                    f"trace {tid} rerouted without an errored attempt-1"
+                rerouted += 1
+        assert rerouted >= 1, \
+            "the mid-burst kill produced no rerouted trace " \
+            "(no trace carries router/attempt-2)"
+
+        # -- hedged request: revive the victim, stall it, hedge beats it ---
+        deadline = time.monotonic() + 10.0
+        victim.revive()
+        while not all(r["healthy"] for r in tier.router.replica_states()):
+            assert time.monotonic() < deadline, "victim never re-admitted"
+            time.sleep(0.02)
+        # stall whichever replica's dispatcher takes the NEXT launch (the
+        # hedged request's primary leg, wherever the router places it);
+        # the hedge then races from the un-stalled peer
+        faults.install(faults.FaultSchedule([faults.FaultRule(
+            site=faults.SITE_ENGINE_LAUNCH, times=1, name="stall_primary",
+            action=faults.delay(1.2))]))
+        try:
+            hcli = TierClient(
+                "127.0.0.1", tier.port, trace=True, recorder=rec,
+                retry=RetryPolicy(max_attempts=2, hedge_after_s=0.15,
+                                  deadline_s=20.0, seed=3))
+            t0 = time.monotonic()
+            hedged = hcli.score(x[0].tolist(), seed=11)
+            hedge_wall = time.monotonic() - t0
+            assert len(hedged) == 1, hedged
+            assert hcli.retry_stats["hedges"] == 1, hcli.retry_stats
+            assert hedge_wall < 1.0, \
+                f"hedge did not beat the 1.2s stall ({hedge_wall:.2f}s)"
+            hcli.close()
+        finally:
+            faults.clear()
+        # find the hedge trace: the one containing a client/hedge span
+        deadline = time.monotonic() + 20.0
+        hdoc = None
+        while hdoc is None and time.monotonic() < deadline:
+            for d in rec.traces():
+                if any(s["name"] == "client/hedge" for s in d["spans"]):
+                    hdoc = d
+                    break
+            time.sleep(0.02)
+        assert hdoc is not None, "hedged request produced no hedge trace"
+        root, names = _tree_check(hdoc, "hedge")
+        assert root["name"] == "client/request", root
+        n_tier = sum(1 for s in hdoc["spans"] if s["name"] == "tier/request")
+        assert n_tier == 2, \
+            f"hedge trace should hold BOTH legs' tier spans, got {n_tier}"
+        assert "client/attempt-1" in names and "client/hedge" in names, names
+
+        # -- wire surface: the traces control op, raw + chrome -------------
+        with TierClient("127.0.0.1", tier.port) as wcli:
+            raw = wcli.traces(limit=4)
+            assert raw["stats"]["retained"] >= len(sizes), raw["stats"]
+            assert len(raw["traces"]) == 4
+            for doc in raw["traces"]:
+                for key in ("trace_id", "root", "duration_s", "error",
+                            "kept", "spans"):
+                    assert key in doc, key
+            chrome = wcli.traces(fmt="chrome")
+            json.dumps(chrome)          # valid JSON by construction
+            assert chrome["traceEvents"], "chrome export is empty"
+            assert any(e.get("ph") == "X" for e in chrome["traceEvents"])
+
+    # -- SLO burn-rate gauges on the tier's Prometheus page -----------------
+    page = prometheus_text(tier.registry)
+    for needle in ("iwae_slo_score_latency_burn_5m",
+                   "iwae_slo_score_availability_burn_1h",
+                   "iwae_slo_score_requests_total"):
+        assert needle in page, f"SLO schema missing {needle} on /metrics"
+    slo_snap = tier.slo.snapshot()
+    assert "score" in slo_snap and "5m" in slo_snap["score"]["windows"]
+
+    tier.stop(timeout_s=30)
+    assert tier.router.outstanding == 0, "drain left requests outstanding"
+    stats = rec.stats()
+    print(f"trace smoke OK: {len(sizes)} traced requests + 1 hedge over "
+          f"TCP, kill mid-burst -> {rerouted} rerouted trace(s), every "
+          f"tree coherent (client->tier->router->engine), bitwise == "
+          f"tracing-off, hedge in {hedge_wall:.2f}s vs 1.2s stall, "
+          f"{stats['retained']} traces retained, SLO gauges live")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"trace smoke FAILED: {e}", file=sys.stderr)
+        sys.exit(1)

@@ -167,6 +167,59 @@ class TestExporters:
         assert "iwae_latency_score_b4_count 3" in page
         assert "iwae_latency_score_b4_sum" in page
 
+    def test_prometheus_help_lines(self):
+        """Every exported family carries a # HELP line before its # TYPE
+        (satellite: today only # TYPE) — known prefixes get real prose,
+        anything else a generic line naming the original path."""
+        reg = MetricRegistry()
+        reg.counter("submitted").inc()
+        reg.gauge("slo/score/latency_burn_5m").set(0.5)
+        reg.histogram("latency/score/b4").record(0.001)
+        page = prometheus_text(reg).splitlines()
+        for metric in ("iwae_submitted_total", "iwae_slo_score_latency_burn_5m",
+                       "iwae_latency_score_b4"):
+            (help_i,) = [i for i, ln in enumerate(page)
+                         if ln.startswith(f"# HELP {metric} ")]
+            assert page[help_i + 1].startswith(f"# TYPE {metric} ")
+            assert len(page[help_i].split(" ", 3)[3]) > 0  # non-empty text
+        # a # HELP for every # TYPE, pairwise
+        types = [ln.split()[2] for ln in page if ln.startswith("# TYPE")]
+        helps = [ln.split()[2] for ln in page if ln.startswith("# HELP")]
+        assert types == helps
+
+    def test_prometheus_sum_is_tracked_total(self):
+        """Histogram `_sum` comes from the Histogram's exact running
+        `total`, not a mean*count reconstruction (satellite)."""
+        reg = MetricRegistry()
+        h = reg.histogram("latency/score/b4")
+        for v in (0.1, 0.1, 0.1):
+            h.record(v)
+        page = prometheus_text(reg)
+        assert f"iwae_latency_score_b4_sum {h.total!r}" in page
+        # the summary document itself now carries the total verbatim
+        assert h.summary()["total"] == h.total
+
+    def test_prometheus_collisions_counted(self):
+        """Same-name instruments across merged registries stay
+        last-writer-wins (documented merge order) but are COUNTED on the
+        process registry's telemetry/export_collisions counter instead of
+        passing silently (satellite)."""
+        c0 = get_registry().counter("telemetry/export_collisions").value
+        a, b = MetricRegistry(), MetricRegistry()
+        a.counter("dup").inc(1)
+        b.counter("dup").inc(5)
+        a.gauge("g_dup").set(1)
+        b.gauge("g_dup").set(2)
+        page = prometheus_text((a, b))
+        assert "iwae_dup_total 5" in page          # last writer still wins
+        assert "iwae_g_dup 2" in page
+        assert get_registry().counter(
+            "telemetry/export_collisions").value == c0 + 2
+        # no collisions -> no increment
+        prometheus_text((MetricRegistry(), MetricRegistry()))
+        assert get_registry().counter(
+            "telemetry/export_collisions").value == c0 + 2
+
     def test_prometheus_merges_registries(self):
         a, b = MetricRegistry(), MetricRegistry()
         a.counter("only_a").inc()
@@ -306,6 +359,443 @@ class TestExporters:
         assert flat["latency/zoo-x/score/b4/count"] == 1.0
         page = prometheus_text(m.registry)
         assert 'iwae_latency_zoo_x_score_b4{quantile="0.5"}' in page
+
+
+# ---------------------------------------------------------------------------
+# request tracing: context, flight recorder, wire round-trip
+# ---------------------------------------------------------------------------
+
+from iwae_replication_project_tpu.telemetry.tracing import (  # noqa: E402
+    FlightRecorder,
+    chrome_trace_events,
+    emit_span,
+    parse_wire_trace,
+    start_span,
+)
+
+
+class TestTraceContext:
+    def test_parse_wire_trace(self):
+        assert parse_wire_trace("abc123") == ("abc123", None)
+        assert parse_wire_trace("abc/def-1") == ("abc", "def-1")
+
+    @pytest.mark.parametrize("bad", [
+        123, {"id": "x"}, ["x"], True,          # non-strings
+        "", "a/b/c", "bad trace!", "x/",        # grammar violations
+        "a" * 130,                              # oversized
+    ])
+    def test_parse_wire_trace_rejects(self, bad):
+        with pytest.raises(ValueError, match="'trace'"):
+            parse_wire_trace(bad)
+
+    def test_span_tree_assembles_on_all_spans_closed(self):
+        rec = FlightRecorder(sample_every=1)
+        root = start_span("client/request", recorder=rec)
+        child = root.child("tier/request", attrs={"op": "score"})
+        emit_span(child.ctx(), "engine/queue", 1.0, 2.0)
+        child.finish()
+        assert rec.traces() == []       # root still open: not finalized
+        root.finish()
+        (doc,) = rec.traces()
+        assert doc["trace_id"] == root.trace_id
+        assert doc["root"] == "client/request"
+        names = {s["name"]: s for s in doc["spans"]}
+        assert set(names) == {"client/request", "tier/request",
+                              "engine/queue"}
+        ids = {s["span_id"] for s in doc["spans"]}
+        assert names["tier/request"]["parent_id"] in ids
+        assert names["engine/queue"]["parent_id"] in ids
+        assert names["tier/request"]["attrs"] == {"op": "score"}
+
+    def test_wire_context_round_trip_joins_tree(self):
+        """A span started from a parsed wire context lands in the SAME
+        trace as the minting side (the fleet-of-fleets hop contract)."""
+        rec = FlightRecorder(sample_every=1)
+        hop = start_span("remote/hop", recorder=rec)
+        tid, parent = parse_wire_trace(hop.ctx().wire())
+        child = start_span("tier/request", recorder=rec, trace_id=tid,
+                           parent_id=parent)
+        child.finish()
+        hop.finish()
+        (doc,) = rec.traces()
+        assert len(doc["spans"]) == 2
+        assert doc["spans"][-1]["parent_id"] == hop.span_id \
+            or doc["spans"][0]["parent_id"] == hop.span_id
+
+    def test_finish_is_idempotent(self):
+        rec = FlightRecorder(sample_every=1)
+        s = start_span("a", recorder=rec)
+        s.finish()
+        s.finish(error="late")          # second close: dropped
+        (doc,) = rec.traces()
+        assert len(doc["spans"]) == 1 and doc["error"] is False
+
+
+class TestFlightRecorder:
+    def _one_trace(self, rec, error=None, duration=0.0):
+        s = start_span("r", recorder=rec, t_start=100.0)
+        s.finish(error=error, t_end=100.0 + duration)
+        return s.trace_id
+
+    def test_schema_pins(self):
+        """The retained trace document and stats schemas other tools
+        (iwae-trace, the traces wire op, the smoke) consume."""
+        rec = FlightRecorder(sample_every=1)
+        root = start_span("client/request", recorder=rec)
+        root.child("tier/request").finish(error="timeout")
+        root.finish()
+        (doc,) = rec.traces()
+        assert set(doc) == {"trace_id", "root", "duration_s", "error",
+                            "kept", "spans"}
+        assert doc["error"] is True and doc["kept"] == "error"
+        for s in doc["spans"]:
+            assert set(s) == {"span_id", "parent_id", "name", "t_start_s",
+                              "duration_s", "attrs", "error"}
+        stats = rec.stats()
+        for key in ("traces_started", "finalized", "kept_error",
+                    "kept_slow", "kept_sampled", "dropped", "late_spans",
+                    "open_overflow", "abandoned", "retained", "open",
+                    "capacity", "sample_every", "slow_fraction"):
+            assert key in stats, key
+
+    def test_tail_sampling_keeps_errors_and_one_in_n(self):
+        rec = FlightRecorder(sample_every=10, slow_min_history=10 ** 6)
+        for i in range(40):
+            self._one_trace(rec, error="internal" if i == 17 else None)
+        kept = {d["kept"] for d in rec.traces()}
+        stats = rec.stats()
+        assert stats["kept_error"] == 1
+        assert stats["kept_sampled"] == 4       # 1-in-10 of 40
+        assert stats["dropped"] == 40 - 5
+        assert kept == {"error", "sampled"}
+
+    def test_tail_sampling_keeps_slow_tail(self):
+        rec = FlightRecorder(sample_every=10 ** 6, slow_min_history=20,
+                             slow_fraction=0.10)
+        for _ in range(30):
+            self._one_trace(rec, duration=0.01)
+        assert rec.stats()["kept_slow"] == 0
+        slow_tid = self._one_trace(rec, duration=5.0)
+        assert [d["trace_id"] for d in rec.traces()
+                if d["kept"] == "slow"] == [slow_tid]
+
+    def test_ring_capacity_bound(self):
+        rec = FlightRecorder(capacity=4, sample_every=1)
+        tids = [self._one_trace(rec) for _ in range(10)]
+        docs = rec.traces()
+        assert len(docs) == 4
+        assert [d["trace_id"] for d in docs] == tids[-4:]
+        assert rec.traces(limit=2) == docs[-2:]
+        # limit=0 = NO bodies (the iwae-trace --stats query), not the
+        # whole ring via a docs[-0:] slice
+        assert rec.traces(limit=0) == []
+        assert [d["trace_id"] for d in rec.traces(trace_id=tids[-1])] == \
+            [tids[-1]]
+
+    def test_late_spans_counted_not_leaked(self):
+        rec = FlightRecorder(sample_every=1)
+        s = start_span("r", recorder=rec)
+        ctx = s.ctx()
+        s.finish()
+        emit_span(ctx, "late", 0.0, 1.0)        # trace already finalized
+        assert rec.stats()["late_spans"] == 1
+        (doc,) = rec.traces()
+        assert len(doc["spans"]) == 1
+
+    def test_open_overflow_bounded(self):
+        rec = FlightRecorder(sample_every=1, max_open=2, open_ttl_s=10 ** 6)
+        spans = [start_span(f"s{i}", recorder=rec) for i in range(5)]
+        assert rec.stats()["open"] == 2
+        assert rec.stats()["open_overflow"] == 3
+        for s in spans:
+            s.finish()
+
+    def test_chrome_trace_events_valid(self):
+        import json as _json
+        rec = FlightRecorder(sample_every=1)
+        root = start_span("client/request", recorder=rec)
+        root.child("tier/request", attrs={"op": "score"}).finish()
+        root.finish(error="timeout")
+        doc = chrome_trace_events(rec.traces())
+        _json.loads(_json.dumps(doc))           # valid JSON end to end
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(xs) == 2
+        for e in xs:
+            assert e["ts"] >= 0 and e["dur"] >= 0 and e["tid"] == 1
+            assert "trace_id" in e["args"] and "span_id" in e["args"]
+        assert any(e["args"].get("error") == "timeout" for e in xs)
+
+    def test_latency_exemplars_link_quantiles_to_traces(self):
+        """Satellite of the tentpole: the serving latency histograms carry
+        trace-id exemplars, so a quantile readout names a real trace."""
+        from iwae_replication_project_tpu.serving.metrics import (
+            ServingMetrics)
+        m = ServingMetrics()
+        for i in range(20):
+            m.record_latency("score", 4, 0.001 * (i + 1),
+                             trace_id=f"tid-{i}")
+        m.record_latency("encode", 4, 0.001)    # no exemplar: absent below
+        snap = m.snapshot()
+        ex = snap["latency_exemplars"]
+        assert set(ex) == {"score/b4"}
+        assert ex["score/b4"]["p99"] == "tid-19"
+        assert ex["score/b4"]["p50"] is not None
+        h = m.registry.histogram("latency/score/b4")
+        near = h.exemplar_near(0.99)
+        assert near == {"value": 0.020, "label": "tid-19"}
+
+
+class _TraceFakeEngine:
+    """Trace-blind fake (no ``traces`` attr): the router must keep the
+    trace kwarg away from it while still recording its attempt spans."""
+
+    row_dims = {"score": 4}
+    k = 5
+
+    def submit(self, op, row, k=None, *, seed=None):
+        from concurrent.futures import Future
+        f = Future()
+        f.set_result(float(seed))
+        return f
+
+    def start(self):
+        pass
+
+    def stop(self, timeout_s=60.0):
+        pass
+
+    def warmup(self, ops=(), ks=None):
+        return {}
+
+
+class TestTraceWire:
+    """Trace-context wire round-trip over a real socket (satellite)."""
+
+    @pytest.fixture()
+    def tier(self):
+        from iwae_replication_project_tpu.serving.frontend import ServingTier
+        rec = FlightRecorder(sample_every=1)
+        t = ServingTier([_TraceFakeEngine()], port=0, recorder=rec)
+        t.start()
+        yield t, rec
+        t.stop(timeout_s=10)
+
+    def _client(self, tier, **kw):
+        from iwae_replication_project_tpu.serving.frontend import TierClient
+        return TierClient("127.0.0.1", tier.port, **kw)
+
+    def test_accepted_trace_joins_and_survives(self, tier):
+        t, rec = tier
+        with self._client(t) as cli:
+            rid = cli._next_id = cli._next_id + 1
+            import json as _json
+            cli._sock.sendall((_json.dumps(
+                {"id": rid, "op": "score", "x": [0.0] * 4,
+                 "trace": "cafe1234/parent-1"}) + "\n").encode())
+            assert cli.wait(rid) == [0.0]
+        docs = rec.traces(trace_id="cafe1234")
+        deadline = __import__("time").monotonic() + 5.0
+        while not docs and __import__("time").monotonic() < deadline:
+            docs = rec.traces(trace_id="cafe1234")
+        (doc,) = docs
+        names = {s["name"] for s in doc["spans"]}
+        assert {"tier/request", "tier/admit", "router/attempt-1"} <= names
+        tier_span = next(s for s in doc["spans"]
+                         if s["name"] == "tier/request")
+        # the wire parent id is preserved even though that span lives in
+        # another process's recorder
+        assert tier_span["parent_id"] == "parent-1"
+
+    @pytest.mark.parametrize("bad", [
+        {"not": "a string"}, 123, ["x"],
+        "way/too/many/parts", "bad chars!", "x" * 200,
+    ])
+    def test_malformed_trace_is_typed_bad_request(self, tier, bad):
+        import json as _json
+
+        from iwae_replication_project_tpu.serving.frontend.client import (
+            TierError)
+        t, rec = tier
+        with self._client(t) as cli:
+            cli._next_id += 1
+            rid = cli._next_id
+            cli._sock.sendall((_json.dumps(
+                {"id": rid, "op": "score", "x": [0.0] * 4,
+                 "trace": bad}) + "\n").encode())
+            with pytest.raises(TierError) as ei:
+                cli.wait(rid)
+            assert ei.value.code == "bad_request"
+            assert "trace" in str(ei.value)
+            # the connection SURVIVES the rejection, and the rejected
+            # request consumed no admission-order seed (result = seed 0)
+            assert cli.score([0.0] * 4) == [0.0]
+        # the malformed request recorded no trace
+        assert all(d["root"] != "tier/request" or not d["error"]
+                   for d in rec.traces())
+
+    def test_minted_trace_and_traces_op(self, tier):
+        t, rec = tier
+        with self._client(t) as cli:
+            assert cli.score([0.0] * 4) == [0.0]    # tier mints the trace
+            raw = cli.traces()
+            assert raw["stats"]["retained"] >= 1
+            (doc,) = raw["traces"][-1:]
+            assert doc["root"] == "tier/request"    # no client span: tier
+            chrome = cli.traces(fmt="chrome")       # is the local root
+            assert any(e.get("ph") == "X" for e in chrome["traceEvents"])
+
+    def test_disconnect_closes_orphaned_client_spans(self, tier):
+        """A dropped connection's unanswered pipelined requests must close
+        their auto-minted root spans errored NOW — not linger open until
+        the recorder's abandon TTL (and the id->span map must not grow
+        across reconnects)."""
+        import time as _time
+        t, rec = tier
+        cli = self._client(t, trace=True, recorder=rec)
+        cli.submit("score", [0.0] * 4)
+        assert len(cli._spans) == 1
+        cli.close()             # response never read
+        assert cli._spans == {}
+        deadline = _time.monotonic() + 5.0
+        doc = None
+        while doc is None and _time.monotonic() < deadline:
+            for d in rec.traces():
+                client_spans = [s for s in d["spans"]
+                                if s["name"] == "client/request"]
+                if client_spans and client_spans[0]["error"] == "connection":
+                    doc = d
+            _time.sleep(0.01)
+        assert doc is not None, \
+            f"orphaned client span never closed: {rec.stats()}"
+        assert doc["kept"] == "error"
+
+    def test_tracing_off_still_validates_and_answers_empty(self):
+        from iwae_replication_project_tpu.serving.frontend import ServingTier
+        from iwae_replication_project_tpu.serving.frontend.client import (
+            TierError)
+        t = ServingTier([_TraceFakeEngine()], port=0, tracing=False)
+        t.start()
+        try:
+            with self._client(t) as cli:
+                import json as _json
+                cli._next_id += 1
+                rid = cli._next_id
+                cli._sock.sendall((_json.dumps(
+                    {"id": rid, "op": "score", "x": [0.0] * 4,
+                     "trace": 42}) + "\n").encode())
+                with pytest.raises(TierError) as ei:
+                    cli.wait(rid)
+                assert ei.value.code == "bad_request"
+                assert cli.score([0.0] * 4) == [0.0]
+                doc = cli.traces()
+                assert doc == {"stats": None, "traces": []}
+        finally:
+            t.stop(timeout_s=10)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate monitor
+# ---------------------------------------------------------------------------
+
+from iwae_replication_project_tpu.telemetry.slo import (  # noqa: E402
+    SLOMonitor,
+    SLOObjective,
+)
+
+
+class TestSLO:
+    def test_objective_validation(self):
+        with pytest.raises(ValueError, match="latency_s"):
+            SLOObjective(latency_s=0)
+        with pytest.raises(ValueError, match="latency_target"):
+            SLOObjective(latency_target=1.0)
+
+    def test_burn_rate_math(self):
+        """burn = violation fraction / (1 - target), per window."""
+        clock = [1000.0]
+        reg = MetricRegistry()
+        mon = SLOMonitor(registry=reg,
+                         default=SLOObjective(latency_s=0.1,
+                                              latency_target=0.9,
+                                              availability_target=0.99),
+                         clock=lambda: clock[0])
+        for _ in range(8):
+            mon.observe("score", 0.01)              # good
+        mon.observe("score", 0.5)                   # latency violation
+        mon.observe("score", 0.01, error_code="internal")   # error (both)
+        snap = mon.snapshot()["score"]["windows"]["5m"]
+        assert snap["requests"] == 10
+        # 2/10 latency-bad over a 0.10 budget -> burn 2.0
+        assert snap["latency_burn"] == pytest.approx(2.0)
+        # 1/10 errors over a 0.01 budget -> burn 10.0
+        assert snap["availability_burn"] == pytest.approx(10.0)
+        # gauges carry the same numbers (the Prometheus surface)
+        assert reg.gauge("slo/score/latency_burn_5m").value == \
+            pytest.approx(2.0)
+        # the 1h window saw the same 10 observations -> same burn
+        assert reg.gauge("slo/score/availability_burn_1h").value == \
+            pytest.approx(10.0)
+        assert reg.counter("slo/score/requests").value == 10
+        assert reg.counter("slo/score/latency_violations").value == 2
+        assert reg.counter("slo/score/errors").value == 1
+
+    def test_windows_rotate_with_the_clock(self):
+        clock = [0.0]
+        mon = SLOMonitor(registry=MetricRegistry(),
+                         windows=((30.0, "30s"),), buckets_per_window=3,
+                         clock=lambda: clock[0])
+        mon.observe("score", 9.0)                   # violation at t=0
+        assert mon.snapshot()["score"]["windows"]["30s"]["requests"] == 1
+        clock[0] = 31.0                             # a full window later
+        mon.observe("score", 0.0)
+        w = mon.snapshot()["score"]["windows"]["30s"]
+        assert w["requests"] == 1                   # old bucket expired
+        assert w["latency_burn"] == 0.0
+
+    def test_client_faults_never_burn(self):
+        mon = SLOMonitor(registry=MetricRegistry())
+        mon.observe("score", 0.001, error_code="quota_exceeded")
+        w = mon.snapshot()["score"]["windows"]["5m"]
+        assert w["availability_burn"] == 0.0
+
+    def test_model_labeled_keys_and_objective_lookup(self):
+        reg = MetricRegistry()
+        mon = SLOMonitor(
+            registry=reg,
+            objectives={("zoo-a", "score"): SLOObjective(latency_s=9.0)})
+        assert mon.objective_for("zoo-a", "score").latency_s == 9.0
+        assert mon.objective_for("zoo-b", "score") is mon.default
+        mon.observe("score", 0.001, model="zoo-a")
+        assert "zoo-a/score" in mon.snapshot()
+        assert "iwae_slo_zoo_a_score_latency_burn_5m" in \
+            prometheus_text(reg)
+
+    def test_tier_publishes_slo_schema(self):
+        """The serving tier's default monitor: burn gauges appear on the
+        tier registry (= the fleet Prometheus page) after traffic, and
+        bad_request traffic never mints a key (schema pin)."""
+        from iwae_replication_project_tpu.serving.frontend import (
+            ServingTier, TierClient)
+        from iwae_replication_project_tpu.serving.frontend.client import (
+            TierError)
+        t = ServingTier([_TraceFakeEngine()], port=0, tracing=False)
+        t.start()
+        try:
+            with TierClient("127.0.0.1", t.port) as cli:
+                cli.score([0.0] * 4)
+                with pytest.raises(TierError):
+                    cli.request("nonsense-op", [0.0] * 4)
+        finally:
+            t.stop(timeout_s=10)
+        page = prometheus_text(t.registry)
+        for needle in ("iwae_slo_score_latency_burn_5m",
+                       "iwae_slo_score_latency_burn_1h",
+                       "iwae_slo_score_availability_burn_5m",
+                       "iwae_slo_score_availability_burn_1h",
+                       "iwae_slo_score_requests_total"):
+            assert needle in page, needle
+        assert "nonsense" not in page
+        assert set(t.slo.snapshot()) == {"score"}
 
 
 # ---------------------------------------------------------------------------
