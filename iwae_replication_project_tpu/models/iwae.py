@@ -207,6 +207,14 @@ def decode_probs(params: Params, cfg: ModelConfig, h1: jax.Array) -> jax.Array:
 def log_px_given_h(params: Params, cfg: ModelConfig, x: jax.Array,
                    h1: jax.Array) -> jax.Array:
     """``log p(x|h)`` summed over pixels -> ``[k, B]`` (flexible_IWAE.py:123-129)."""
+    if "out_q" in params:
+        # the int8 precision policy (ISSUE 16): the serving engine replaced
+        # the fp32 output block with its weight-only-quantized twin
+        # (hot_loop.quantize_out_block) at load, so the scoring path reads
+        # int8 weights + per-channel fp32 scales. Only the serving score
+        # program builds such a tree; train/eval params always carry "out".
+        from iwae_replication_project_tpu.ops import hot_loop
+        return hot_loop.decoder_score_int8(params["out_q"], x, h1)
     if cfg.fused_likelihood:
         # the hot-loop dispatcher (ops/hot_loop.py): the FULL output block
         # (three matmuls + tanh + Bernoulli + pixel reduction) blocked over

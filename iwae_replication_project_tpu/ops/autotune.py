@@ -76,7 +76,7 @@ AUTOTUNE_VERSION = 1
 #: the winner-cache file, living beside the persistent XLA cache
 CACHE_FILENAME = "autotune_cache.json"
 
-KINDS = ("fwd", "bwd", "scan", "serving_row")
+KINDS = ("fwd", "bwd", "scan", "serving_row", "serving_int8")
 
 #: tk candidates (sublane multiples) and tb candidates (lane multiples)
 #: for the kernel tile search — superset of the hand-picked TILE_K=8 /
@@ -232,6 +232,8 @@ class Candidate:
             return f"pallas{self.tile}"
         if self.path == "blocked_scan":
             return f"blocked_scan(bk={self.block_k})"
+        if self.path == "int8":
+            return "int8(weight-only)"
         return "reference"
 
 
@@ -297,6 +299,13 @@ def candidates_for(kind: str, k: int, b: int, h1_dim: int, hid: int,
         out += [Candidate("blocked_scan", block_k=bk)
                 for bk in _scan_blocks(k)]
         out.append(Candidate("reference"))
+    elif kind == "serving_int8":
+        # the precision-admission race (ISSUE 16): the weight-only int8
+        # row program vs the exact fp32 reference, both plain XLA, so the
+        # verdict is measurable on any backend. The winner's path ("int8"
+        # or "reference") IS hot_loop.serving_int8_admit's verdict.
+        out.append(Candidate("int8"))
+        out.append(Candidate("reference"))
     else:
         raise ValueError(f"unknown autotune kind {kind!r}; choose {KINDS}")
     return out
@@ -322,11 +331,23 @@ def _operands(kind: str, k: int, b: int, h1_dim: int, hid: int,
             jnp.asarray(rs.randn(hid, n_pixels).astype(f32) * 0.2),
             jnp.asarray(rs.randn(n_pixels).astype(f32) * 0.1),
             jnp.asarray((rs.rand(b, n_pixels) > 0.5).astype(f32))]
-    if kind == "serving_row":
+    if kind in ("serving_row", "serving_int8"):
         # the row-vmapped composition: per-row [k, 1, .] latents and
         # [1, d] targets, vmapped over the b request rows
         args[0] = jnp.moveaxis(args[0], 1, 0)[:, :, None, :]  # [b, k, 1, h1]
         args[-1] = args[-1][:, None, :]                       # [b, 1, d]
+    if kind == "serving_int8":
+        # quantize OUTSIDE the measured program (production quantizes once
+        # at engine load, so the timed program must read the int8 weights
+        # from HBM, not quantize fp32 ones in-trace): the shared operand
+        # tuple carries both weight forms — the fp32 block for the
+        # reference leg, the quantized pytree for the int8 leg
+        from iwae_replication_project_tpu.ops.hot_loop import (
+            quantize_out_block)
+        args.append(quantize_out_block(
+            {"l1": {"w": args[1], "b": args[2]},
+             "l2": {"w": args[3], "b": args[4]},
+             "out": {"w": args[5], "b": args[6]}}))
     return tuple(args)
 
 
@@ -337,6 +358,16 @@ def _candidate_fn(kind: str, cand: Candidate, k: int, on_tpu: bool,
     from iwae_replication_project_tpu.ops import hot_loop as hl
 
     cd = compute_dtype if compute_dtype not in ("None", "f32") else None
+
+    if kind == "serving_int8":
+        def per_row_q(h1, w1, b1, w2, b2, w3, b3, x, out_q):
+            if cand.path == "int8":
+                return hl.decoder_score_int8(out_q, x, h1)
+            return hl._reference_impl(h1, w1, b1, w2, b2, w3, b3, x, cd)
+
+        import jax
+        return jax.vmap(per_row_q, in_axes=(0, None, None, None, None,
+                                            None, None, 0, None))
 
     if kind == "serving_row":
         def per_row(h1, w1, b1, w2, b2, w3, b3, x):

@@ -91,8 +91,10 @@ from iwae_replication_project_tpu.ops.fused_likelihood import (
 from iwae_replication_project_tpu.utils.flops import largest_divisor_leq
 
 #: selection outcome -> the value of the ``kernel_path`` telemetry gauge
-#: (numeric so the gauge exports through JSONL/TB/Prometheus like any scalar)
-PATH_CODES = {"reference": 0, "blocked_scan": 1, "pallas": 2}
+#: (numeric so the gauge exports through JSONL/TB/Prometheus like any scalar).
+#: ``int8`` is the weight-only-quantized serving path (ISSUE 16): not a
+#: selectable train path — only :func:`serving_int8_admit` routes to it.
+PATH_CODES = {"reference": 0, "blocked_scan": 1, "pallas": 2, "int8": 3}
 
 #: default auto-threshold (bytes) for preferring the blocked scan over the
 #: materializing reference path off-TPU: the reference working set is
@@ -492,6 +494,132 @@ def serving_dispatch_config(cfg, k: int, rows: int, *, on_tpu: bool,
     return dataclasses.replace(cfg, likelihood="logits",
                                fused_likelihood=True, hot_loop_path=path,
                                hot_loop_tile=tile), path, tile
+
+
+# --------------------------------------------------------------------------
+# The int8 weight-only serving path (ISSUE 16)
+# --------------------------------------------------------------------------
+#
+# The ``int8`` precision policy quantizes the decoder output block's matmul
+# WEIGHTS symmetric-per-output-channel at engine load: weights become int8
+# with one fp32 scale per output channel, biases and activations stay fp32,
+# and every matmul accumulates in fp32. The per-channel scale commutes with
+# the row-times-matrix product (each output channel j is
+# ``sum_i x[i] * w[i, j]``, uniformly scaled by ``scale[j]``), so dequantizing
+# AFTER the matmul is exact up to the rounding already spent at quantization
+# time. iwae-cost's roofline says the serving decoder is memory-bound at
+# small buckets, so quartering weight bytes is the latency lever; whether it
+# is an actual win on the running chip is decided by measurement — the
+# ``serving_int8`` autotune kind via :func:`serving_int8_admit` — never
+# assumed. Numerical acceptance is the statistical-parity contract
+# (telemetry/parity.py), NOT bitwise parity: the quantized program is a
+# different (lossy) function of the weights by construction.
+
+def quantize_out_block(out_params) -> dict:
+    """Weight-only symmetric per-output-channel int8 quantization of the
+    decoder output block (``l1``/``l2``/``out`` dense layers).
+
+    Each layer ``{"w": [in, out] f32, "b": [out] f32}`` becomes
+    ``{"w_q": [in, out] int8, "scale": [out] f32, "b": [out] f32}`` with
+    ``scale[j] = max(|w[:, j]|) / 127`` (an all-zero channel gets scale 1.0
+    so the divide stays finite — its quantized column is exactly zero
+    anyway) and ``w_q = clip(round(w / scale), -127, 127)``. Runs once at
+    engine load, outside any trace.
+    """
+    def one(layer):
+        w = jnp.asarray(layer["w"], jnp.float32)
+        amax = jnp.max(jnp.abs(w), axis=0)
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        w_q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+        return {"w_q": w_q, "scale": scale,
+                "b": jnp.asarray(layer["b"], jnp.float32)}
+
+    return {name: one(out_params[name]) for name in ("l1", "l2", "out")}
+
+
+def _dense_wq(x, layer):
+    """Dense apply against one quantized layer: fp32 activations against the
+    int8 weights with fp32 accumulation, per-output-channel dequant AFTER
+    the matmul (exact — the scale is constant along the contraction)."""
+    y = jnp.dot(x, layer["w_q"].astype(jnp.float32),
+                preferred_element_type=jnp.float32)
+    return y * layer["scale"] + layer["b"]
+
+
+def decoder_score_int8(out_q, x, h1) -> jnp.ndarray:
+    """``log p(x | h1)`` summed over pixels -> ``[k, B]`` through the
+    quantized output block — the int8 twin of :func:`_reference_impl`
+    (same composition, same logits-form Bernoulli reduction, fp32
+    everywhere except the weight storage). `out_q` is the pytree
+    :func:`quantize_out_block` built; `x` is ``[B, D]``, `h1` ``[k, B, H1]``.
+    """
+    _record_path("int8")
+    y1 = jnp.tanh(_dense_wq(h1, out_q["l1"]))
+    y2 = jnp.tanh(_dense_wq(y1, out_q["l2"]))
+    logits = _dense_wq(y2, out_q["out"])
+    ll = x[None] * logits - jax.nn.softplus(logits)
+    return jnp.sum(ll, axis=-1)
+
+
+_int8_admit_cache: dict = {}
+
+
+def serving_int8_admit(k: int, rows: int, h1_dim: int, hid: int,
+                       n_pixels: int, *, on_tpu: bool) -> Tuple[bool, str]:
+    """``(admitted, reason)`` — may the int8-quantized program serve this
+    (bucket=`rows`, `k`) shape?
+
+    The measured-win contract of the tentpole: int8 ships only where the
+    ``serving_int8`` autotune kind (ops/autotune.py) measured the quantized
+    row program faster than the exact fp32 reference on THIS chip; anything
+    else — measured slower, measurement failed, or no measurement possible
+    (off-TPU with no persisted winner) — keeps the exact fp32 program, and
+    the reason string says why (engines surface it in telemetry).
+    ``IWAE_SERVING_INT8`` overrides: ``force`` admits unconditionally (how
+    CPU CI exercises the quantized path), ``off`` rejects unconditionally,
+    ``auto``/unset measures; any other value raises — the same
+    loud-unknown-env contract as ``IWAE_HOT_LOOP_PATH``. Decisions are
+    cached per (shape, env) for the engine's resolve-once discipline.
+    """
+    env = os.environ.get("IWAE_SERVING_INT8", "auto").lower()
+    if env not in ("auto", "force", "off"):
+        raise ValueError(f"IWAE_SERVING_INT8={env!r}: expected "
+                         f"auto | force | off")
+    if env == "force":
+        return True, "forced via IWAE_SERVING_INT8=force"
+    if env == "off":
+        return False, "disabled via IWAE_SERVING_INT8=off"
+    key = (k, rows, h1_dim, hid, n_pixels, on_tpu)
+    hit = _int8_admit_cache.get(key)
+    if hit is not None:
+        return hit
+    win = _autotune_winner("serving_int8", k, rows, h1_dim, hid, n_pixels,
+                           None)
+    if win is None and on_tpu:
+        # no persisted verdict: measure now, once, fail-soft (a failed
+        # search must degrade to the exact fp32 program, never crash
+        # engine construction)
+        try:
+            from iwae_replication_project_tpu.ops import autotune
+            win = autotune.tune("serving_int8", k, rows, h1_dim, hid,
+                                n_pixels)
+        except Exception:
+            win = None
+    if win is None:
+        verdict = (False,
+                   "autotune measurement failed; serving the exact fp32 "
+                   "program" if on_tpu else
+                   "no measured winner and not on TPU; int8 admission "
+                   "requires a measured win (set IWAE_SERVING_INT8=force "
+                   "to override)")
+    elif win.get("path") == "int8":
+        verdict = (True, f"measured faster than the fp32 reference "
+                         f"({win.get('measured_ms')} ms)")
+    else:
+        verdict = (False, "measured slower than the fp32 reference at "
+                          "this shape")
+    _int8_admit_cache[key] = verdict
+    return verdict
 
 
 # --------------------------------------------------------------------------

@@ -25,6 +25,15 @@ from typing import Tuple
 
 import numpy as np
 
+# the serving boundaries' shared validators live next to each other: model
+# and k are defined below; the precision policy vocabulary is owned by
+# utils.dtypes (the byte-width table that makes the policy billable) and
+# re-exported here so every admission boundary imports one module
+from iwae_replication_project_tpu.utils.dtypes import (  # noqa: F401
+    PRECISIONS,
+    validate_precision,
+)
+
 
 def as_row(row, n_features: int, op: str) -> np.ndarray:
     """One request payload as a flat float32 ``[n_features]`` row.

@@ -119,6 +119,17 @@ def build_argparser() -> argparse.ArgumentParser:
                          "bucket, k) selection — ops/hot_loop."
                          "serving_select_path; 'reference' restores the "
                          "pre-ISSUE-12 serving pin)")
+    ap.add_argument("--precision", type=str, default=None,
+                    help="serving precision policy (fp32 | bf16 | int8): "
+                         "one value applies to every engine; comma-"
+                         "separated model=precision pairs (with --models) "
+                         "configure per tenant, unmapped models serving "
+                         "the historical fp32 path. bf16 scores with bf16 "
+                         "operands / fp32 accumulation; int8 quantizes "
+                         "decoder weights at load and ships only where the "
+                         "autotuner measured it faster (exact fp32 "
+                         "fallback otherwise). Unknown values are a typed "
+                         "error here, never a silent fp32 fleet")
     tier = ap.add_argument_group("serving tier (serving/frontend/)")
     tier.add_argument("--replicas", type=int, default=0,
                       help="run the network tier with N engine replicas "
@@ -251,16 +262,59 @@ def _engine_knobs(args) -> dict:
         ladder=ladder, seed=args.seed, kernel_path=args.kernel_path)
 
 
+def _parse_precision(spec):
+    """``--precision``: one policy name applies fleet-wide; comma-separated
+    ``model=precision`` pairs configure per model. Returns None, a str, or
+    a ``{model: precision}`` dict. A typo'd policy is a typed SystemExit
+    HERE, at the CLI boundary — it must never silently become fp32."""
+    from iwae_replication_project_tpu.serving.buckets import (
+        validate_precision)
+
+    if spec is None:
+        return None
+    try:
+        if "=" not in spec:
+            return validate_precision(spec)
+        out = {}
+        for part in (s for s in spec.split(",") if s):
+            model, eq, prec = part.partition("=")
+            if not model or not eq:
+                raise ValueError(f"bad --precision entry {part!r}; "
+                                 f"expected model=precision")
+            out[model] = validate_precision(prec)
+        return out
+    except ValueError as e:
+        raise SystemExit(f"--precision: {e}")
+
+
+def _single_engine_precision(args):
+    """The one policy a single-weight-source mode serves. Pairs may only
+    name the preset actually being served — extra keys are a typo, not a
+    no-op."""
+    prec = _parse_precision(args.precision)
+    if isinstance(prec, dict):
+        extra = sorted(set(prec) - ({args.preset} if args.preset else set()))
+        if extra:
+            raise SystemExit(f"--precision names models {extra} but this "
+                             f"mode serves only "
+                             f"{args.preset or 'the flagship default'} "
+                             f"(per-model pairs need --models)")
+        prec = prec.get(args.preset)
+    return prec
+
+
 def _build_engine(args):
     from iwae_replication_project_tpu.serving.engine import ServingEngine
 
+    prec = _single_engine_precision(args)
     if args.checkpoint:
-        return ServingEngine(args.checkpoint, k=args.k,
+        return ServingEngine(args.checkpoint, k=args.k, precision=prec,
                              **_engine_knobs(args))
     from iwae_replication_project_tpu import zoo
     from iwae_replication_project_tpu.utils.config import ExperimentConfig
     ecfg = zoo.get(args.preset) if args.preset else ExperimentConfig()
-    return zoo.serving_engine(ecfg, k=args.k, **_engine_knobs(args))
+    return zoo.serving_engine(ecfg, k=args.k, precision=prec,
+                              **_engine_knobs(args))
 
 
 def _k_split(args):
@@ -327,7 +381,10 @@ def _build_replicas(args, n: int):
         from iwae_replication_project_tpu import zoo
         names = [s for s in args.models.split(",") if s]
         engines = zoo.serving_engines(names, replicas_per_model=max(1, n),
-                                      k=args.k, **_engine_knobs(args))
+                                      k=args.k,
+                                      precisions=_parse_precision(
+                                          args.precision),
+                                      **_engine_knobs(args))
         if fast_k_max is not None:
             for e in engines:       # the k-split applies per fast replica
                 e.k_max = max(fast_k_max, e.k)
@@ -348,7 +405,8 @@ def _build_replicas(args, n: int):
     for _ in range(1, n):
         engines.append(ServingEngine(
             params=first._params, model_config=first.cfg, k=first.k,
-            k_max=first.k_max, **_engine_knobs(args)))
+            k_max=first.k_max, precision=first.precision,
+            **_engine_knobs(args)))
     if args.sharded_replicas > 0:
         engines.extend(_sharded_engines(args, [(None, first)]))
     return engines

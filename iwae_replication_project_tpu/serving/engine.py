@@ -64,6 +64,7 @@ from iwae_replication_project_tpu.serving.buckets import (
     as_rows,
     validate_k,
     validate_model,
+    validate_precision,
 )
 from iwae_replication_project_tpu.serving.faults import (
     SITE_ENGINE_FETCH,
@@ -131,7 +132,21 @@ class ServingEngine:
     its latency histograms, and is the replica capability snapshot the
     router's model-affinity classification reads; a submit naming a
     DIFFERENT model is the typed ``bad_request``. ``None`` = the historical
-    single-model engine, schema-identical to pre-multi-tenant builds).
+    single-model engine, schema-identical to pre-multi-tenant builds),
+    ``precision`` (the per-model serving precision policy, ISSUE 16:
+    ``None`` = the historical fp32 engine, key- and schema-identical to
+    pre-precision builds; ``"fp32"`` pins the exact program explicitly;
+    ``"bf16"`` runs decoder scoring with bf16 operands / fp32 accumulation;
+    ``"int8"`` serves the weight-only-quantized decoder output block —
+    int8 weights + per-channel fp32 scales, quantized once at load — but
+    ONLY where the measured admission gate
+    (ops/hot_loop.serving_int8_admit) says the quantized program wins;
+    every rejected shape serves the exact fp32 program. The policy rides
+    the AOT build key, the executable-store tenant label
+    (:attr:`store_label`), and the metrics labels, so fp32 and
+    low-precision tenants of one model coexist in one store budget without
+    colliding; an unknown precision string raises the typed ValueError —
+    never a silent fp32 fallback).
     """
 
     def __init__(self, source=None, *, params=None, model_config=None,
@@ -143,7 +158,8 @@ class ServingEngine:
                  ladder: Optional[BucketLadder] = None, seed: int = 0,
                  metrics: Optional[ServingMetrics] = None,
                  kernel_path: Optional[str] = None,
-                 model: Optional[str] = None):
+                 model: Optional[str] = None,
+                 precision: Optional[str] = None):
         import jax
 
         if isinstance(source, str):
@@ -174,9 +190,23 @@ class ServingEngine:
         # previously pinned path. `kernel_path` forces one outcome for the
         # whole engine ("reference" restores the historical pin — the bench
         # baseline and the parity tests' oracle).
+        #: the serving precision policy (validated at the construction
+        #: boundary: a typo'd policy dies HERE, not as a silent fp32 engine)
+        self.precision = validate_precision(precision) \
+            if precision is not None else None
         self.cfg = dataclasses.replace(model_config, fused_likelihood=False,
                                        hot_loop_path=None,
                                        hot_loop_tile=None)
+        if self.precision == "bf16":
+            # bf16 operands / fp32 accumulation on every dense apply — the
+            # compute_dtype the hot loop already has parity coverage for
+            self.cfg = dataclasses.replace(self.cfg,
+                                           compute_dtype="bfloat16")
+        elif self.precision in ("fp32", "int8"):
+            # the exact-oracle base program: an explicit fp32 policy pins
+            # it; int8 needs it too — every shape the admission gate
+            # rejects serves this exact program
+            self.cfg = dataclasses.replace(self.cfg, compute_dtype=None)
         if kernel_path is not None and kernel_path not in (
                 "pallas", "blocked_scan", "reference"):
             raise ValueError(f"kernel_path={kernel_path!r}: expected None "
@@ -218,7 +248,8 @@ class ServingEngine:
         self.ladder = ladder or BucketLadder.powers_of_two(max_batch)
         if self.ladder.max_batch != max_batch:
             max_batch = self.ladder.max_batch
-        self.metrics = metrics or ServingMetrics(model=self.model)
+        self.metrics = metrics or ServingMetrics(model=self.model,
+                                                 precision=self.precision)
         self._clock = time.monotonic
         self._batcher = MicroBatcher(max_batch=max_batch,
                                      max_wait_us=max_wait_us,
@@ -228,6 +259,23 @@ class ServingEngine:
         # only ever device_puts the per-batch payload explicitly, and runs
         # clean under jax.transfer_guard("disallow") (tests/test_sanitize.py)
         self._params = jax.device_put(params)
+        #: the int8 policy's quantized parameter tree (None otherwise):
+        #: shares the encoder/decoder chain buffers with ``_params`` by
+        #: reference and swaps the fp32 output block for its weight-only
+        #: int8 twin — the "out" leaves are ABSENT, so the quantized
+        #: program's signature (and its executable-store billing,
+        #: utils/dtypes byte widths) carries the genuinely smaller bytes
+        self._params_q = None
+        #: (op, k, bucket) -> the admission gate's verdict reason (int8
+        #: policy only) — why a shape serves quantized or exact, surfaced
+        #: through ServingTier.info/bench so the fallback is observable
+        self.int8_admission: Dict[tuple, str] = {}
+        if self.precision == "int8":
+            from iwae_replication_project_tpu.ops.hot_loop import (
+                quantize_out_block)
+            self._params_q = {key: val for key, val in self._params.items()
+                              if key != "out"}
+            self._params_q["out_q"] = quantize_out_block(self._params["out"])
         self._base_key = jax.device_put(jax.random.PRNGKey(seed))
         self._seed_counter = 0
         self._lock = threading.Lock()
@@ -499,10 +547,26 @@ class ServingEngine:
         rejection: automatic fallback, never a crash)."""
         from iwae_replication_project_tpu.models.iwae import _on_tpu
         from iwae_replication_project_tpu.ops.hot_loop import (
-            serving_dispatch_config)
+            serving_dispatch_config,
+            serving_int8_admit,
+        )
 
         if op not in self._GATED_OPS:
             return self.cfg, "reference", None
+        if self.precision == "int8":
+            # the measured-win contract: the quantized program serves this
+            # shape only where the serving_int8 autotune kind ranked it
+            # faster than the exact fp32 reference (or the env forces it);
+            # any rejection falls through to the standard gate below — the
+            # exact fp32 program, with the reason kept for telemetry
+            from iwae_replication_project_tpu.ops.autotune import (
+                dims_for_model)
+            h1_dim, hid, n_pixels = dims_for_model(self.cfg)
+            admitted, reason = serving_int8_admit(k, bucket, h1_dim, hid,
+                                                  n_pixels, on_tpu=_on_tpu())
+            self.int8_admission[(op, k, bucket)] = reason
+            if admitted:
+                return self.cfg, "int8", None
         return serving_dispatch_config(self.cfg, k, bucket,
                                        on_tpu=_on_tpu(),
                                        force=self.kernel_path_force)
@@ -534,13 +598,35 @@ class ServingEngine:
         payload_dev, seeds_dev = jax.device_put((payload, seeds))
         kwargs = dict(base_key=self._base_key, seeds=seeds_dev)
         kwargs["h_top" if op == "decode" else "x"] = payload_dev
-        static = dict(cfg=self._kernel_for(op, k, len(payload))[0])
+        cfg, path, _ = self._kernel_for(op, k, len(payload))
+        static = dict(cfg=cfg)
         if takes_k:
             static["k"] = k
-        return (self._params,), kwargs, static
+        # an int8-admitted dispatch serves the quantized tree (its "out_q"
+        # leaves route log p(x|h) through the quantized scorer); every
+        # other path — including int8-policy shapes the gate rejected —
+        # serves the exact fp32 parameters
+        params = self._params_q if path == "int8" else self._params
+        return (params,), kwargs, static
 
     def _build_key(self, op: str, k: int, bucket: int) -> tuple:
-        return (op, self._kernel_for(op, k, bucket)[0], k, bucket)
+        key = (op, self._kernel_for(op, k, bucket)[0], k, bucket)
+        # the precision policy rides the build key (ISSUE 16): an fp32 and
+        # a bf16/int8 engine over the SAME weights/config must never share
+        # an executable. None keeps the historical 4-tuple exactly.
+        return key if self.precision is None else key + (self.precision,)
+
+    @property
+    def store_label(self) -> Optional[str]:
+        """The executable-store tenant label this engine's programs key
+        under: the model name, ``@precision``-suffixed when a precision
+        policy is set, so (model, precision) variants hold DISTINCT store
+        entries — evicted, billed, and reported per variant — under one
+        process-wide budget. ``None`` (no model, no policy) keeps the
+        historical unlabeled store schema."""
+        if self.precision is None:
+            return self.model
+        return f"{self.model or 'default'}@{self.precision}"
 
     def _aot_name(self, op: str) -> str:
         """Registry/span name of the op's program (subclasses that swap in
@@ -586,8 +672,8 @@ class ServingEngine:
         # pin the dispatch's store entry until completion: a multi-tenant
         # budget squeeze (another model's admission) must never evict an
         # executable while this batch is between enqueue and fetch
-        pin = executable_store().pin_prefix(self.model, self._aot_name(op),
-                                            build_key)
+        pin = executable_store().pin_prefix(self.store_label,
+                                            self._aot_name(op), build_key)
         try:
             # spans nest: serve/dispatch/aot/serve_<op> — the outer one (in
             # the engine's own registry) covers pad+device_put+enqueue, NOT
@@ -598,7 +684,7 @@ class ServingEngine:
                 out = aot_call_async(
                     self._aot_name(op), program, args,
                     kwargs=kwargs, static_kwargs=static,
-                    build_key=build_key, model=self.model)
+                    build_key=build_key, model=self.store_label)
         except BaseException:
             pin.release()
             raise
@@ -747,7 +833,7 @@ class ServingEngine:
                                  self._program_for(op, k, bucket), args,
                                  kwargs=kwargs, static_kwargs=static,
                                  build_key=self._build_key(op, k, bucket),
-                                 model=self.model)
+                                 model=self.store_label)
                         _, path, tile = self._kernel_for(op, k, bucket)
                         self.metrics.set_kernel(op, self._stamp_k(op, k),
                                                 bucket, PATH_CODES[path],

@@ -35,7 +35,10 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
-from iwae_replication_project_tpu.serving.buckets import validate_k
+from iwae_replication_project_tpu.serving.buckets import (
+    validate_k,
+    validate_precision,
+)
 from iwae_replication_project_tpu.serving.faults import (
     SITE_TIER_WRITE,
     fault_point,
@@ -186,6 +189,24 @@ class _Connection:
                 model = self._tier.router.resolve_model(model)
             except ValueError as e:
                 raise protocol.ProtocolError(str(e)) from None
+            precision = obj.get("precision")
+            if precision is not None:
+                # the wire surface of the typed unknown-precision contract
+                # (ISSUE 16): validate the vocabulary via the ONE shared
+                # validator, then assert the fleet actually serves this
+                # model at the asked-for numerics — a mismatch is this
+                # request's typed bad_request, NEVER a silent serve at
+                # whatever precision happens to be resident
+                try:
+                    validate_precision(precision)
+                except ValueError as e:
+                    raise protocol.ProtocolError(str(e)) from None
+                held = self._tier.precisions_for(model)
+                if precision not in held:
+                    raise protocol.ProtocolError(
+                        f"model {model!r} is not served at precision "
+                        f"{precision!r} here; this fleet holds "
+                        f"{sorted(held)}")
             k = obj.get("k")
             if k is not None:
                 # the protocol surface of the typed out-of-range-k
@@ -442,6 +463,19 @@ class ServingTier:
         self.slo.observe(op, self.clock() - t_start, model=model,
                          error_code=error_code)
 
+    def precisions_for(self, model: Optional[str]) -> set:
+        """The serving precision policies this fleet holds for `model`
+        (every replica, for ``None`` — the unlabeled single-model fleet).
+        An engine with no policy serves exact fp32, so it reads as
+        ``"fp32"`` here: a client asserting ``precision: "fp32"`` against
+        a legacy fleet is satisfied, not rejected."""
+        out = set()
+        for e in self.router.engines:
+            if model is not None and getattr(e, "model", None) != model:
+                continue
+            out.add(getattr(e, "precision", None) or "fp32")
+        return out
+
     def traces_doc(self, obj: Dict[str, Any]) -> Dict[str, Any]:
         """The ``{"op": "traces"}`` control response: the flight recorder's
         retained traces (``limit``/``trace_id`` filters), as raw documents
@@ -491,10 +525,14 @@ class ServingTier:
             m = getattr(e, "model", None)
             if m is None:
                 continue
-            doc = models.setdefault(m, {"ops": set(), "row_dims": {},
-                                        "k": getattr(e, "k", None),
-                                        "k_max": getattr(e, "k_max", None),
-                                        "replicas": 0})
+            doc = models.setdefault(
+                m, {"ops": set(), "row_dims": {},
+                    "k": getattr(e, "k", None),
+                    "k_max": getattr(e, "k_max", None),
+                    # the serving precision policy of this tenant's
+                    # replicas (None-policy engines serve exact fp32)
+                    "precision": getattr(e, "precision", None) or "fp32",
+                    "replicas": 0})
             doc["ops"].update(getattr(e, "row_dims", {}))
             doc["row_dims"].update(getattr(e, "row_dims", {}))
             doc["replicas"] += 1

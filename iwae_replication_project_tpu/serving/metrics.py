@@ -59,9 +59,16 @@ class ServingMetrics:
     ``latency/<model>/<op>/b<bucket>`` (flat/Prometheus alike) so one
     exposition page over a zoo-serving tier separates tenants. ``None`` (the
     single-model default) keeps the historical unlabeled schema byte-for-
-    byte. Snapshots additionally carry the process-wide executable-store
-    section (``store``: hits/misses/evictions/demotions/readmits,
-    resident-vs-budget bytes — utils/compile_cache.store_stats())."""
+    byte. ``precision`` (ISSUE 16) adds the serving-precision dimension the
+    same way: a non-None policy suffixes the tenant label
+    (``<model>@<precision>``, matching the engine's executable-store label),
+    stamps each kernel gate outcome with its precision, and adds a
+    ``precision`` key to snapshots — while ``None`` keeps every schema, key,
+    and byte identical to a pre-precision fleet (the fp32-only contract
+    pinned by tests/test_telemetry.py). Snapshots additionally carry the
+    process-wide executable-store section (``store``:
+    hits/misses/evictions/demotions/readmits, resident-vs-budget bytes —
+    utils/compile_cache.store_stats())."""
 
     COUNTERS = ("submitted", "completed", "timeouts", "shed", "errors",
                 "dispatches", "real_rows", "padded_rows",
@@ -73,9 +80,11 @@ class ServingMetrics:
                   "resident_bytes", "entries")
 
     def __init__(self, registry: Optional[MetricRegistry] = None,
-                 model: Optional[str] = None):
+                 model: Optional[str] = None,
+                 precision: Optional[str] = None):
         self.registry = registry if registry is not None else MetricRegistry()
         self.model = model
+        self.precision = precision
         # pre-register so snapshots carry every counter from the first call
         for name in self.COUNTERS:
             self.registry.counter(name)
@@ -122,18 +131,35 @@ class ServingMetrics:
         ``kernel/<op>/b<bucket>/k<k>`` gauge (scraped on the Prometheus
         page like any scalar); the tile joins it in snapshot()/flat()."""
         key = f"{op}/b{bucket}/k{k}"
+        if self.precision:
+            # the precision dimension of the kernel stamp (ISSUE 16):
+            # fp32-only fleets (precision None) keep the historical key
+            key = f"{key}/{self.precision}"
         self.registry.gauge(f"kernel/{key}").set(float(path_code))
         with self._kernel_lock:
-            self._kernel[key] = {
+            rec = {
                 "path_code": int(path_code), "path": str(path),
                 "tile": list(tile) if tile is not None else None,
             }
+            if self.precision:
+                rec["precision"] = str(self.precision)
+            self._kernel[key] = rec
+
+    def _label(self) -> Optional[str]:
+        """The tenant label of this engine's histogram keys: the model
+        name, ``@precision``-suffixed when a precision policy is set —
+        the SAME composite the engine keys its executable-store entries
+        under, so the latency split and the store residency split name
+        tenants identically."""
+        if self.precision:
+            return f"{self.model or 'default'}@{self.precision}"
+        return self.model
 
     def _hist_key(self, op: str, bucket: int) -> str:
-        """The per-(op, bucket) histogram key, model-labeled when this
-        engine serves a named tenant."""
-        return f"{self.model}/{op}/b{bucket}" if self.model \
-            else f"{op}/b{bucket}"
+        """The per-(op, bucket) histogram key, tenant-labeled when this
+        engine serves a named model and/or a precision policy."""
+        label = self._label()
+        return f"{label}/{op}/b{bucket}" if label else f"{op}/b{bucket}"
 
     def record_latency(self, op: str, bucket: int, seconds: float,
                        trace_id: Optional[str] = None) -> None:
@@ -201,7 +227,7 @@ class ServingMetrics:
                                     "resident_bytes", "budget_bytes",
                                     "entries")}
         store["per_model"] = st["per_model"]
-        return {
+        doc = {
             "model": self.model,
             "store": store,
             "counters": c,
@@ -221,6 +247,11 @@ class ServingMetrics:
             "queue_wait": section(_QW),
             "device_wait": section(_DW),
         }
+        if self.precision:
+            # present ONLY under a precision policy: an fp32-only fleet's
+            # snapshot stays byte-identical to pre-precision builds
+            doc["precision"] = self.precision
+        return doc
 
     def flat(self) -> Dict[str, float]:
         """Flat scalar dict for utils/logging.MetricsLogger (JSONL/TB): one

@@ -16,6 +16,9 @@ is imported explicitly by the call sites that compute diagnostics):
   export;
 * :mod:`.slo` — :class:`SLOMonitor`: per-(model, op) latency/availability
   objectives published as multi-window burn-rate gauges;
+* :mod:`.parity` — :func:`statistical_parity`: the toleranced acceptance
+  gate low-precision (bf16/int8) serving legs must pass against the fp32
+  oracle (pure-numpy, offline — check stages / bench legs / tests);
 * :mod:`.diagnostics` — :class:`DiagnosticsConfig`-gated ESS / log-weight
   variance / gradient-SNR / active-units reductions that run inside the
   jitted train/eval programs.
@@ -24,6 +27,11 @@ is imported explicitly by the call sites that compute diagnostics):
 from iwae_replication_project_tpu.telemetry.exporters import (
     prometheus_text,
     start_metrics_server,
+)
+from iwae_replication_project_tpu.telemetry.parity import (
+    DEFAULT_TOLERANCES,
+    ParityTolerances,
+    statistical_parity,
 )
 from iwae_replication_project_tpu.telemetry.registry import (
     Counter,
@@ -54,4 +62,5 @@ __all__ = [
     "prometheus_text", "start_metrics_server",
     "FlightRecorder", "TraceContext", "chrome_trace_events", "get_recorder",
     "SLOMonitor", "SLOObjective",
+    "DEFAULT_TOLERANCES", "ParityTolerances", "statistical_parity",
 ]

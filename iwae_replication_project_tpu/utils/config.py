@@ -107,6 +107,14 @@ class ExperimentConfig:
     # JSONs pin their own value, so pre-r5 checkpoints/configs reproduce
     # their f32 numbers exactly; every metrics row stamps `bfloat16`.
     compute_dtype: Optional[str] = "bfloat16"
+    # serving precision policy (ISSUE 16): None (the historical fp32 path)
+    # | "fp32" | "bf16" | "int8" — the per-model policy zoo.serving_engine
+    # hands the ServingEngine when this config is served. A SERVING knob:
+    # training/eval never read it, and it is not a science field (does not
+    # change run_name()). Typos die in __post_init__, the same
+    # loud-unknown contract as compute_dtype — a misspelled policy must
+    # never silently serve fp32.
+    serving_precision: Optional[str] = None
 
     def __post_init__(self):
         # now that bf16 must be actively turned OFF, the opt-out must not
@@ -118,6 +126,10 @@ class ExperimentConfig:
             raise ValueError(
                 f"compute_dtype must be None, 'float32' or 'bfloat16', got "
                 f"{self.compute_dtype!r}")
+        if self.serving_precision is not None:
+            from iwae_replication_project_tpu.utils.dtypes import (
+                validate_precision)
+            validate_precision(self.serving_precision)
         if self.checkpoint_every_passes < 0:
             raise ValueError(
                 f"checkpoint_every_passes must be >= 0 (0 = stage boundaries "
@@ -298,6 +310,11 @@ def build_argparser() -> argparse.ArgumentParser:
                     type=int)
     ap.add_argument("--process-id", dest="process_id", default=None, type=int)
     ap.add_argument("--compute-dtype", dest="compute_dtype", default=None, type=str)
+    ap.add_argument("--serving-precision", dest="serving_precision",
+                    default=None, type=str,
+                    help="serving precision policy for this config "
+                         "(fp32 | bf16 | int8); read by zoo.serving_engine "
+                         "/ iwae-serve, never by training")
     ap.add_argument("--likelihood", default=None, type=str)
     ap.add_argument("--fused-likelihood", dest="fused_likelihood",
                     action="store_true", default=None)

@@ -13,7 +13,16 @@ in the codebase:
   ``compile_cache._abstract_signature`` records, or a weak-typed python
   scalar's inferred dtype (plain ``int``/``float``/``bool``/``complex``
   names map to the x64-off production widths: i32/f32/bool/c64);
-* :func:`aval_bytes` — total buffer bytes of one abstract value.
+* :func:`aval_bytes` — total buffer bytes of one abstract value;
+* :data:`PRECISIONS` / :func:`validate_precision` — the serving precision
+  policy vocabulary (ISSUE 16): ``fp32`` (the exact oracle), ``bf16``
+  (bf16 operands, fp32 accumulation), ``int8`` (weight-only symmetric
+  per-output-channel quantization, fp32 scales + accumulation). The byte
+  widths above are what make the policy *billable*: an int8-quantized
+  executable's signature carries ``int8`` weight leaves plus small fp32
+  per-channel scale vectors, so ``compile_cache._signature_arg_bytes`` and
+  the cost analyzer size it at its true (smaller) bytes with no special
+  casing.
 
 Production numerics are x64-off bf16/f32 (the dtype-promotion lint rule),
 so the table is small and explicit; anything unrecognized falls back to
@@ -39,6 +48,31 @@ BYTE_WIDTHS = {
     # grammar stores these as type names)
     "int": 4, "float": 4, "complex": 8,
 }
+
+
+#: the serving precision policies (ISSUE 16). Order is documentation only;
+#: ``fp32`` is the default and the statistical-parity oracle.
+PRECISIONS = ("fp32", "bf16", "int8")
+
+
+def validate_precision(precision: Any) -> str:
+    """Shared unknown-precision check: one of :data:`PRECISIONS` or
+    ValueError (the typed ``bad_request``).
+
+    One implementation for every boundary a precision policy crosses —
+    experiment config, engine construction, zoo presets, the ``iwae-serve
+    --precision`` CLI, and the wire protocol — so a typo'd policy string
+    dies loudly at the first boundary it crosses and is NEVER a silent
+    fp32 fallback (which would quietly serve different numerics than the
+    tenant asked for).
+    """
+    if not isinstance(precision, str) or not precision:
+        raise ValueError(f"precision must be a non-empty string, got "
+                         f"{type(precision).__name__}")
+    if precision not in PRECISIONS:
+        raise ValueError(f"unknown precision {precision!r}; serving "
+                         f"precision policies are {list(PRECISIONS)}")
+    return precision
 
 
 def byte_width(dtype: Any) -> int:

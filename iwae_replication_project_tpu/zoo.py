@@ -127,6 +127,8 @@ def serving_engine(config_or_name, *, checkpoint_dir: str = None,
     what load tests and the ``iwae-serve`` synthetic profile want. `k`
     defaults to the preset's training k (every score/encode request then
     pays the same importance-sample budget the model was trained under).
+    A config carrying ``serving_precision`` serves under that policy
+    unless an explicit ``precision=`` kwarg overrides it.
     """
     from iwae_replication_project_tpu.serving.engine import ServingEngine
 
@@ -138,6 +140,8 @@ def serving_engine(config_or_name, *, checkpoint_dir: str = None,
     from iwae_replication_project_tpu.training import create_train_state
     cfg = get(config_or_name) if isinstance(config_or_name, str) \
         else config_or_name
+    if knobs.get("precision") is None and cfg.serving_precision is not None:
+        knobs["precision"] = cfg.serving_precision
     state = create_train_state(jax.random.PRNGKey(cfg.seed),
                                cfg.model_config())
     return ServingEngine(params=state.params,
@@ -146,7 +150,8 @@ def serving_engine(config_or_name, *, checkpoint_dir: str = None,
 
 
 def serving_engines(names, *, replicas_per_model: int = 1, k: int = None,
-                    checkpoint_dirs: Dict[str, str] = None, **knobs):
+                    checkpoint_dirs: Dict[str, str] = None,
+                    precisions=None, **knobs):
     """Multi-model replica set from a zoo manifest: one (or
     ``replicas_per_model``) model-labeled :class:`~.serving.ServingEngine`
     per preset name, ready to hand a :class:`~.serving.frontend.ServingTier`
@@ -159,20 +164,39 @@ def serving_engines(names, *, replicas_per_model: int = 1, k: int = None,
     Replicas of the same model share one set of weights (initialized once).
     ``checkpoint_dirs`` optionally maps preset names to experiment run
     directories (trained weights); unmapped names serve fresh inits, which
-    is what load tests and benches want.
+    is what load tests and benches want. ``precisions`` sets the serving
+    precision policy (ISSUE 16): one string applies fleet-wide, a
+    ``{name: precision}`` dict configures per model (unmapped names serve
+    the historical fp32 path). Unknown precision strings — and dict keys
+    naming no requested preset — raise here, at the zoo boundary: a typo'd
+    policy must never silently become an fp32 engine.
     """
+    from iwae_replication_project_tpu.serving.buckets import (
+        validate_precision)
+
+    if isinstance(precisions, str):
+        validate_precision(precisions)
+    elif precisions:
+        unknown = sorted(set(precisions) - set(names))
+        if unknown:
+            raise ValueError(f"precisions maps models not in this "
+                             f"manifest: {unknown}; serving {list(names)}")
+        for p in precisions.values():
+            validate_precision(p)
     engines = []
     for name in names:
         get(name)                   # unknown preset fails loudly up front
         ckpt = (checkpoint_dirs or {}).get(name)
+        prec = precisions if isinstance(precisions, str) \
+            else (precisions or {}).get(name)
         first = serving_engine(name, checkpoint_dir=ckpt, k=k,
-                               model=name, **knobs)
+                               model=name, precision=prec, **knobs)
         engines.append(first)
         from iwae_replication_project_tpu.serving.engine import ServingEngine
         for _ in range(1, max(1, int(replicas_per_model))):
             engines.append(ServingEngine(
                 params=first._params, model_config=first.cfg, k=first.k,
-                k_max=first.k_max, model=name, **knobs))
+                k_max=first.k_max, model=name, precision=prec, **knobs))
     return engines
 
 

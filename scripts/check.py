@@ -42,14 +42,21 @@ machine-readable summary.
    response bitwise-correct vs dedicated single-model engines, zero
    fresh compiles once warm (evictions demote to the persistent cache
    and readmit by deserialization);
-12. **trace smoke** (scripts/trace_smoke.py) — end-to-end request tracing
+12. **precision parity smoke** (scripts/precision_parity_smoke.py) — the
+   low-precision serving contract: bf16/int8 legs pass the statistical
+   acceptance gate (telemetry/parity.py) while a corrupted leg is
+   rejected, explicit-fp32 policy stays bitwise, one tier serves fp32 +
+   bf16 tenants of the same model with zero fresh compiles once warm,
+   and int8 admission is honest (forced path stamps ``int8``; auto with
+   no measured win serves the exact fp32 program);
+13. **trace smoke** (scripts/trace_smoke.py) — end-to-end request tracing
    over a real socket: a ragged burst with a replica killed mid-burst
    plus a hedged request, every request yielding ONE coherent trace tree
    (client -> tier -> router attempts -> engine stages) in the
    tail-sampled flight recorder, results bitwise identical to a
    tracing-off tier, the ``traces`` wire op valid in raw and Chrome
    formats, and SLO burn-rate gauges live on the Prometheus page;
-13. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
+14. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
    ``--sanitize`` armed.
 
 Every full-gate run writes ``results/check_summary.json`` (per-stage status,
@@ -219,6 +226,12 @@ def run_multi_model_smoke() -> dict:
                                                   "multi_model_smoke.py")])
 
 
+def run_precision_parity_smoke() -> dict:
+    return run_step("precision parity smoke",
+                    [sys.executable, os.path.join(
+                        "scripts", "precision_parity_smoke.py")])
+
+
 def run_trace_smoke() -> dict:
     return run_step("trace smoke",
                     [sys.executable, os.path.join("scripts",
@@ -271,6 +284,7 @@ def main(argv=None) -> int:
         stages.append(run_autotune_smoke())
         stages.append(run_chaos_smoke())
         stages.append(run_multi_model_smoke())
+        stages.append(run_precision_parity_smoke())
         stages.append(run_trace_smoke())
     if not args.lint_only:
         stages.append(run_tests(passthrough))

@@ -1,0 +1,208 @@
+"""Low-precision serving parity smoke stage for scripts/check.py (ISSUE 16).
+
+One short CPU process that proves the precision-policy stack end to end
+with REAL engines, a REAL socket tier, and the one shared acceptance gate
+(telemetry/parity.py):
+
+1. **statistical parity at the model level** — the same rows / seeds / k
+   scored through the fp32 oracle, the bf16 program, and the
+   weight-only-int8 program produce ``[k, B]`` log-weights that PASS
+   :func:`statistical_parity` under their policy tolerances, while a
+   deliberately corrupted leg is REJECTED (the gate gates);
+
+2. **fp32 policy is bitwise** — a ``precision="fp32"`` tenant answers
+   bit-identically to the no-policy oracle engine (the explicit-fp32
+   policy is pinning, not a new program);
+
+3. **one fleet, two precisions, 0 fresh compiles** — one ServingTier
+   serving the SAME weights as an fp32 tenant and a bf16 tenant
+   side by side: every burst request ok, fp32 rows bitwise, bf16 rows
+   inside the row tolerance, both ``@precision``-suffixed store labels
+   resident, and the whole warm burst performs ZERO fresh XLA compiles;
+
+4. **int8 admission honesty** — with ``IWAE_SERVING_INT8=force`` the
+   quantized path really serves (stamped ``path int8``) and stays inside
+   the int8 row tolerance; in ``auto`` mode with no measured win the
+   engine records the rejection reason and serves the exact fp32 program
+   bitwise.
+
+Uses the same deliberately tiny architecture as serving_smoke.py: this
+checks the precision contract, not throughput — ``bench.py --precision``
+owns the numbers. Exit 0 on success, 1 with a message on the first failed
+check.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        setup_persistent_cache)
+
+    # warm-path discipline, like every entry point
+    setup_persistent_cache(base_dir=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from iwae_replication_project_tpu.models import iwae as model
+    from iwae_replication_project_tpu.ops.hot_loop import quantize_out_block
+    from iwae_replication_project_tpu.serving import ServingEngine
+    from iwae_replication_project_tpu.serving.frontend import (
+        ServingTier, TierClient)
+    from iwae_replication_project_tpu.telemetry.parity import (
+        BF16_TOLERANCES, INT8_TOLERANCES, statistical_parity)
+    from iwae_replication_project_tpu.utils import compile_cache as cc
+
+    D, K, B = 24, 8, 32
+    cfg = model.ModelConfig(x_dim=D, n_hidden_enc=(16,), n_latent_enc=(6,),
+                            n_hidden_dec=(16,), n_latent_dec=(D,))
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    xb = (rng.rand(B, D) > 0.5).astype(np.float32)
+
+    # ---- 1. statistical parity of the three programs over one batch.
+    # Every leg draws from a freshly constructed IDENTICAL key: shared
+    # randomness is the parity contract (the legs must differ only in
+    # arithmetic), not key reuse across independent draws.
+    cfg_bf16 = dataclasses.replace(cfg, compute_dtype="bfloat16")
+    params_q = {name: val for name, val in params.items() if name != "out"}
+    params_q["out_q"] = quantize_out_block(params["out"])
+    legs = {"fp32": (params, cfg), "bf16": (params, cfg_bf16),
+            "int8": (params_q, cfg)}
+    log_w = {leg: np.asarray(model.log_weights(
+                 p, c, jax.random.PRNGKey(7), xb, K))
+             for leg, (p, c) in legs.items()}
+    for leg, tol in (("bf16", BF16_TOLERANCES), ("int8", INT8_TOLERANCES)):
+        v = statistical_parity(log_w["fp32"], log_w[leg], tol)
+        assert v["accepted"], \
+            f"{leg} leg failed statistical parity: {v['failures']}"
+    # the gate must also REJECT: a uniform +1 nat bias is a wrong program
+    v = statistical_parity(log_w["fp32"], log_w["fp32"] + 1.0,
+                           INT8_TOLERANCES)
+    assert not v["accepted"], "parity gate accepted a +1-nat-biased leg"
+
+    def engine(precision, label):
+        return ServingEngine(params=params, model_config=cfg, k=K,
+                             max_batch=4, max_inflight=2, timeout_s=30.0,
+                             model=label, precision=precision)
+
+    n_requests = 16
+    rows = (rng.rand(n_requests, D) > 0.5).astype(np.float32)
+
+    # ---- oracle: the no-policy engine (results are a pure function of
+    # (weights, payload, seed, k), so it is the bitwise reference for
+    # every fp32-program leg below)
+    with cc.isolated_aot_registry():
+        oracle = engine(None, None)
+        futs = [oracle.submit("score", rows[i], seed=i)
+                for i in range(n_requests)]
+        oracle.flush()
+        ref = [float(f.result()) for f in futs]
+
+    # ---- 2 + 3. one fleet, two precisions of the SAME model
+    tier = ServingTier([engine("fp32", "tenant-fp32"),
+                        engine("bf16", "tenant-bf16")], port=0)
+    warm = tier.warmup(ops=("score",))
+    assert warm["programs"] > 0, warm
+    tier.start()
+    s0 = cc.cache_stats()
+    tenants = [("tenant-fp32" if i % 2 == 0 else "tenant-bf16")
+               for i in range(n_requests)]
+    with TierClient("127.0.0.1", tier.port) as cli:
+        ids = [cli.submit("score", rows[i].tolist(), seed=i,
+                          model=tenants[i])
+               for i in range(n_requests)]
+        responses = cli.drain(ids)
+        stats = cli.stats()
+    d = cc.stats_delta(s0)
+    tier.stop(timeout_s=30)
+
+    bad = [responses[rid] for rid in ids if not responses[rid]["ok"]]
+    assert not bad, f"mixed-precision burst had failures: {bad[:2]}"
+    # per-row allowance at this shape, from the same relative row bound
+    # the statistical gate enforces (|log p̂| ~ 17 nats at D=24)
+    scale = max(1.0, abs(float(np.mean(ref))))
+    for i, rid in enumerate(ids):
+        got = float(responses[rid]["result"][0])
+        if tenants[i] == "tenant-fp32":
+            assert got == ref[i], \
+                (f"row {i}: explicit fp32 policy diverged from the "
+                 f"no-policy oracle: {got!r} != {ref[i]!r}")
+        else:
+            delta = abs(got - ref[i])
+            assert delta <= BF16_TOLERANCES.max_row_rel_delta * scale, \
+                f"row {i}: bf16 tenant off by {delta} nats"
+    assert d["persistent_cache_misses"] == 0, \
+        f"warm mixed-precision burst caused fresh XLA compiles: {d}"
+    per_model = stats["store"]["per_model"]
+    assert {"tenant-fp32@fp32", "tenant-bf16@bf16"} <= set(per_model), \
+        f"precision-suffixed store labels missing: {sorted(per_model)}"
+
+    # ---- 4. int8 admission honesty (forced on, then honest auto)
+    saved = os.environ.get("IWAE_SERVING_INT8")
+    try:
+        os.environ["IWAE_SERVING_INT8"] = "force"
+        with cc.isolated_aot_registry():
+            e8 = engine("int8", "tenant-int8")
+            futs = [e8.submit("score", rows[i], seed=i)
+                    for i in range(n_requests)]
+            e8.flush()
+            forced = [float(f.result()) for f in futs]
+            snap8 = e8.metrics.snapshot()
+    finally:
+        if saved is None:
+            os.environ.pop("IWAE_SERVING_INT8", None)
+        else:
+            os.environ["IWAE_SERVING_INT8"] = saved
+    worst = max(abs(a - b) for a, b in zip(forced, ref))
+    assert worst <= INT8_TOLERANCES.max_row_rel_delta * scale, \
+        f"forced int8 engine off by {worst} nats"
+    int8_stamps = [key for key, rec in snap8["kernel"].items()
+                   if rec.get("path") == "int8"]
+    assert int8_stamps, \
+        f"forced int8 engine never stamped the int8 path: {snap8['kernel']}"
+
+    with cc.isolated_aot_registry():
+        e_auto = engine("int8", "tenant-int8-auto")
+        futs = [e_auto.submit("score", rows[i], seed=i)
+                for i in range(n_requests)]
+        e_auto.flush()
+        auto = [float(f.result()) for f in futs]
+        reasons = dict(e_auto.int8_admission)
+    assert reasons, "auto int8 engine recorded no admission decisions"
+    admitted = any(rec.get("path") == "int8" for rec in
+                   e_auto.metrics.snapshot()["kernel"].values())
+    if admitted:
+        # a measured win (TPU): the quantized program serves, gated
+        worst = max(abs(a - b) for a, b in zip(auto, ref))
+        assert worst <= INT8_TOLERANCES.max_row_rel_delta * scale, \
+            f"admitted int8 off by {worst} nats"
+    else:
+        # no measured win (CPU CI): the EXACT fp32 program serves
+        assert auto == ref, \
+            "unadmitted int8 policy did not serve the exact fp32 program"
+
+    print(f"precision parity smoke OK: bf16/int8 legs pass statistical "
+          f"parity (gate rejects a biased leg); fp32 policy bitwise; one "
+          f"fleet served tenant-fp32@fp32 + tenant-bf16@bf16 over "
+          f"{n_requests} TCP requests with 0 fresh compiles; forced int8 "
+          f"stamped path=int8 within tolerance; auto admission honest "
+          f"({next(iter(reasons.values()))!r})")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"precision parity smoke FAILED: {e}", file=sys.stderr)
+        sys.exit(1)

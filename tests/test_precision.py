@@ -1,0 +1,479 @@
+"""Low-precision serving policies (ISSUE 16).
+
+Five layers, mirroring the PR's ownership chain:
+
+* **parity gate** (telemetry/parity.py) — the statistical acceptance
+  helper itself, on synthetic log-weight sets with KNOWN bias/variance:
+  accepts inside every bound, rejects outside in BOTH directions, NaN can
+  never pass, shape mismatch and zero tolerances are typed errors;
+* **vocabulary** — an unknown precision string is a typed error at every
+  boundary (dtypes validator, ExperimentConfig ctor + ``--serving-precision``
+  CLI, ServingEngine ctor, zoo manifest, ``iwae-serve --precision``) and
+  NEVER a silent fp32 fallback;
+* **store hygiene** — (model, precision) variants of one model land under
+  distinct ``model@precision`` store labels (no collision), the int8
+  variant bills FEWER resident bytes than its fp32 twin (weight-only int8
+  is actually smaller, not just relabeled), and eviction accounting stays
+  exact with two precisions of one model resident;
+* **engine** — an explicit fp32 policy is bitwise against the no-policy
+  oracle; bf16/int8 answers stay inside the policy row tolerances; int8
+  auto mode without a measured win serves the exact fp32 program and
+  records WHY;
+* **wire** — ``precision`` on a request is validated by the one shared
+  validator and asserted against what the fleet holds (typed
+  ``bad_request`` both ways, connection survives), and ``info()``
+  declares each tenant's policy.
+"""
+
+import dataclasses
+import json
+import os
+import socket
+from concurrent.futures import Future
+
+import jax
+import numpy as np
+import pytest
+
+from iwae_replication_project_tpu.telemetry.parity import (
+    BF16_TOLERANCES, DEFAULT_TOLERANCES, INT8_TOLERANCES, ParityTolerances,
+    statistical_parity)
+from iwae_replication_project_tpu.utils import compile_cache as cc
+from iwae_replication_project_tpu.utils.dtypes import validate_precision
+
+
+def _log_weights(k=8, b=64, seed=0):
+    """Synthetic [k, B] log-weight matrix with known spread."""
+    return np.random.RandomState(seed).normal(size=(k, b))
+
+
+# ---------------------------------------------------------------------------
+# the statistical acceptance helper itself
+# ---------------------------------------------------------------------------
+
+class TestParityGate:
+    def test_identical_legs_accept_with_zero_deltas(self):
+        lw = _log_weights()
+        v = statistical_parity(lw, lw.copy(), BF16_TOLERANCES)
+        assert v["accepted"] and not v["failures"]
+        assert all(d == 0.0 for d in v["deltas"].values())
+
+    def test_known_bias_inside_bounds_accepts_both_directions(self):
+        """A uniform bias of b nats shifts every row estimate by exactly
+        b, so the gate's behavior on it is analytically known."""
+        lw = _log_weights()
+        for sign in (+1.0, -1.0):
+            v = statistical_parity(lw, lw + sign * 0.015, INT8_TOLERANCES)
+            assert v["accepted"], (sign, v["failures"])
+            assert v["deltas"]["batch_nll"] == pytest.approx(0.015)
+
+    def test_known_bias_outside_bounds_rejects_both_directions(self):
+        """A 'better' NLL (negative bias) is as much a violation as a
+        worse one — the program is not computing the tenant's model."""
+        lw = _log_weights()
+        for sign in (+1.0, -1.0):
+            v = statistical_parity(lw, lw + sign * 1.0, INT8_TOLERANCES)
+            assert not v["accepted"], sign
+            assert any("batch_nll" in f for f in v["failures"])
+
+    def test_known_variance_inflation_rejected(self):
+        """Inflating the per-row spread by f multiplies Var_k[log w] by
+        f^2 — coverage drift the mean-level gates alone would miss."""
+        lw = _log_weights()
+        mean = lw.mean(axis=0, keepdims=True)
+        v = statistical_parity(lw, mean + 2.0 * (lw - mean),
+                               INT8_TOLERANCES)
+        assert not v["accepted"]
+        assert any("log_weight_var_rel" in f for f in v["failures"])
+        assert v["deltas"]["log_weight_var"] == pytest.approx(
+            3.0 * v["ref"]["log_weight_var"], rel=1e-6)
+
+    def test_nan_leg_can_never_be_accepted(self):
+        lw = _log_weights()
+        bad = lw.copy()
+        bad[0, 0] = np.nan
+        v = statistical_parity(lw, bad, INT8_TOLERANCES)
+        assert not v["accepted"] and v["failures"]
+
+    def test_shape_mismatch_is_a_typed_error(self):
+        with pytest.raises(ValueError, match="shapes differ"):
+            statistical_parity(_log_weights(k=8), _log_weights(k=4),
+                               BF16_TOLERANCES)
+
+    def test_zero_or_negative_tolerance_is_a_typed_error(self):
+        """A zero tolerance is a request for bitwise parity — serve fp32
+        instead of building a gate that can only fail."""
+        for bad in (0.0, -0.1):
+            with pytest.raises(ValueError, match="must be > 0"):
+                ParityTolerances(bad, 0.1, 0.1, 0.1)
+
+    def test_defaults_cover_exactly_the_low_precision_policies(self):
+        """fp32 has no statistical gate on purpose: its contract is
+        bitwise identity, checked directly by the callers."""
+        assert set(DEFAULT_TOLERANCES) == {"bf16", "int8"}
+
+    def test_verdict_is_json_ready(self):
+        lw = _log_weights()
+        v = statistical_parity(lw, lw + 0.01, BF16_TOLERANCES)
+        json.dumps(v)   # artifacts (bench, smoke) embed verdicts verbatim
+
+
+# ---------------------------------------------------------------------------
+# vocabulary: typed errors at every boundary, never a silent fp32
+# ---------------------------------------------------------------------------
+
+class TestPrecisionVocabulary:
+    def test_validator_accepts_policies_and_returns_them(self):
+        for p in ("fp32", "bf16", "int8"):
+            assert validate_precision(p) == p
+
+    def test_validator_rejects_unknowns_typed(self):
+        for bad in ("fp16", "FP32", "", "int4", 8, None):
+            with pytest.raises((ValueError, TypeError)):
+                validate_precision(bad)
+
+    def test_config_ctor_boundary(self):
+        from iwae_replication_project_tpu.utils.config import (
+            ExperimentConfig)
+
+        cfg = ExperimentConfig(serving_precision="int8")
+        assert cfg.serving_precision == "int8"
+        with pytest.raises(ValueError, match="fp16"):
+            ExperimentConfig(serving_precision="fp16")
+
+    def test_config_cli_boundary(self):
+        from iwae_replication_project_tpu.utils.config import (
+            config_from_args)
+
+        cfg = config_from_args(["--serving-precision", "bf16"])
+        assert cfg.serving_precision == "bf16"
+        with pytest.raises(ValueError, match="int4"):
+            config_from_args(["--serving-precision", "int4"])
+
+    def test_config_json_roundtrip_keeps_policy(self):
+        from iwae_replication_project_tpu.utils.config import (
+            ExperimentConfig)
+
+        cfg = ExperimentConfig(serving_precision="bf16")
+        back = ExperimentConfig.from_json(cfg.to_json())
+        assert back.serving_precision == "bf16"
+
+    def test_engine_ctor_boundary(self):
+        with pytest.raises(ValueError, match="fp16"):
+            _tiny_engine(precision="fp16")
+
+    def test_zoo_manifest_boundary(self):
+        from iwae_replication_project_tpu import zoo
+
+        with pytest.raises(ValueError, match="fp16"):
+            zoo.serving_engines(["northstar-iwae-2l-k50"],
+                                precisions="fp16")
+        with pytest.raises(ValueError, match="not in this manifest"):
+            zoo.serving_engines(["northstar-iwae-2l-k50"],
+                                precisions={"table1-vae-1l-k1": "bf16"})
+
+    def test_serve_cli_boundary(self):
+        from iwae_replication_project_tpu.serving.cli import (
+            _parse_precision)
+
+        assert _parse_precision(None) is None
+        assert _parse_precision("bf16") == "bf16"
+        assert _parse_precision("m1=bf16,m2=int8") == {"m1": "bf16",
+                                                       "m2": "int8"}
+        for bad in ("fp16", "m1=fp16", "m1=bf16,int8", "=bf16"):
+            with pytest.raises(SystemExit, match="--precision"):
+                _parse_precision(bad)
+
+
+# ---------------------------------------------------------------------------
+# store hygiene + billing: two precisions of one model, one store
+# ---------------------------------------------------------------------------
+
+def _tiny_engine(model=None, precision=None, **kw):
+    from iwae_replication_project_tpu.models import iwae as m
+    from iwae_replication_project_tpu.serving import ServingEngine
+
+    D = 16
+    cfg = m.ModelConfig(x_dim=D, n_hidden_enc=(8,), n_latent_enc=(4,),
+                        n_hidden_dec=(8,), n_latent_dec=(D,))
+    params = m.init_params(jax.random.PRNGKey(0), cfg)
+    return ServingEngine(params=params, model_config=cfg, k=3, max_batch=4,
+                         model=model, precision=precision, **kw)
+
+
+def _serve_one(eng, seed=0):
+    fut = eng.submit("score", [0.5] * 16, seed=seed)
+    eng.flush()
+    return float(fut.result())
+
+
+class TestStorePrecisionHygiene:
+    def _resident_pair(self):
+        """fp32-policy and forced-int8 engines of the SAME model label,
+        one program each, in the caller's isolated store."""
+        e32 = _tiny_engine(model="m", precision="fp32")
+        _serve_one(e32)
+        saved = os.environ.get("IWAE_SERVING_INT8")
+        os.environ["IWAE_SERVING_INT8"] = "force"
+        try:
+            e8 = _tiny_engine(model="m", precision="int8")
+            _serve_one(e8)
+        finally:
+            if saved is None:
+                os.environ.pop("IWAE_SERVING_INT8", None)
+            else:
+                os.environ["IWAE_SERVING_INT8"] = saved
+        return e32, e8
+
+    def test_precision_variants_never_collide(self):
+        with cc.isolated_aot_registry(budget_bytes=None):
+            self._resident_pair()
+            store = cc.executable_store()
+            per_model = store.stats()["per_model"]
+            assert {"m@fp32", "m@int8"} <= set(per_model), \
+                sorted(per_model)
+            # distinct entries, and the precision rides the build key of
+            # every quantized entry (no (model, precision) aliasing)
+            models = [e["model"] for e in store.entries()]
+            assert models.count("m@fp32") >= 1
+            assert models.count("m@int8") >= 1
+            int8_keys = [k for k in store.keys()
+                         if k[0] == "m@int8"]
+            assert int8_keys and all(
+                "int8" in str(k[2]) for k in int8_keys), int8_keys
+
+    def test_int8_entry_bills_less_than_fp32_twin(self):
+        """Weight-only int8 must be cheaper under the store budget, not
+        just relabeled: its params tree swaps fp32 decoder matrices for
+        int8 weights + per-channel fp32 scales."""
+        with cc.isolated_aot_registry(budget_bytes=None):
+            self._resident_pair()
+            per_model = cc.executable_store().stats()["per_model"]
+            b32 = per_model["m@fp32"]["resident_bytes"]
+            b8 = per_model["m@int8"]["resident_bytes"]
+            assert b32 > 0 and b8 > 0
+            assert b8 < b32, (b8, b32)
+
+    def test_eviction_accounting_exact_with_two_precisions(self):
+        with cc.isolated_aot_registry(budget_bytes=None):
+            s0 = cc.cache_stats()
+            self._resident_pair()
+            store = cc.executable_store()
+            stats = store.stats()
+            # resident bytes reconcile bit-exactly across the three views
+            assert stats["resident_bytes"] == \
+                sum(e["bytes"] for e in store.entries()) == \
+                sum(m["resident_bytes"]
+                    for m in stats["per_model"].values())
+            # squeeze until something goes; accounting must stay exact
+            # (per-model counters are process-cumulative, so compare
+            # deltas, not absolutes)
+            pre_ev = {m: v["evictions"]
+                      for m, v in stats["per_model"].items()}
+            store.set_budget(stats["resident_bytes"] - 1)
+            after = store.stats()
+            assert after["resident_bytes"] <= stats["resident_bytes"] - 1
+            assert after["resident_bytes"] == \
+                sum(e["bytes"] for e in store.entries()) == \
+                sum(m["resident_bytes"]
+                    for m in after["per_model"].values())
+            evicted = {m: v["evictions"] - pre_ev.get(m, 0)
+                       for m, v in after["per_model"].items()
+                       if v["evictions"] != pre_ev.get(m, 0)}
+            assert sum(evicted.values()) == \
+                cc.stats_delta(s0)["store_evictions"] > 0
+            # and the churn stayed inside this model's precision variants
+            assert set(evicted) <= {"m@fp32", "m@int8"}, evicted
+
+
+# ---------------------------------------------------------------------------
+# engine: fp32 bitwise, bf16/int8 bounded, auto admission honest
+# ---------------------------------------------------------------------------
+
+class TestEnginePrecision:
+    N = 4
+
+    def _rows(self):
+        rng = np.random.RandomState(1)
+        return (rng.rand(self.N, 16) > 0.5).astype(np.float32)
+
+    def _serve(self, eng):
+        rows = self._rows()
+        futs = [eng.submit("score", rows[i], seed=i)
+                for i in range(self.N)]
+        eng.flush()
+        return [float(f.result()) for f in futs]
+
+    def _oracle(self):
+        with cc.isolated_aot_registry():
+            return self._serve(_tiny_engine())
+
+    def test_fp32_policy_is_bitwise(self):
+        ref = self._oracle()
+        with cc.isolated_aot_registry():
+            assert self._serve(_tiny_engine(precision="fp32")) == ref
+
+    def test_bf16_and_forced_int8_within_row_tolerance(self):
+        ref = self._oracle()
+        scale = max(1.0, abs(float(np.mean(ref))))
+        with cc.isolated_aot_registry():
+            got = self._serve(_tiny_engine(precision="bf16"))
+        worst = max(abs(a - b) for a, b in zip(got, ref))
+        assert worst <= BF16_TOLERANCES.max_row_rel_delta * scale, worst
+
+        saved = os.environ.get("IWAE_SERVING_INT8")
+        os.environ["IWAE_SERVING_INT8"] = "force"
+        try:
+            with cc.isolated_aot_registry():
+                e8 = _tiny_engine(precision="int8")
+                got8 = self._serve(e8)
+                snap = e8.metrics.snapshot()
+        finally:
+            if saved is None:
+                os.environ.pop("IWAE_SERVING_INT8", None)
+            else:
+                os.environ["IWAE_SERVING_INT8"] = saved
+        worst8 = max(abs(a - b) for a, b in zip(got8, ref))
+        assert worst8 <= INT8_TOLERANCES.max_row_rel_delta * scale, worst8
+        # the quantized path really served, stamped with its precision
+        int8_recs = [rec for rec in snap["kernel"].values()
+                     if rec.get("path") == "int8"]
+        assert int8_recs and all(
+            rec["precision"] == "int8" for rec in int8_recs)
+
+    def test_auto_without_measured_win_serves_exact_fp32(self):
+        """CPU CI leg of admission honesty: no autotuner win -> the
+        EXACT fp32 program serves and the engine records why."""
+        ref = self._oracle()
+        with cc.isolated_aot_registry():
+            e = _tiny_engine(precision="int8")
+            got = self._serve(e)
+            reasons = dict(e.int8_admission)
+            admitted = any(rec.get("path") == "int8" for rec in
+                           e.metrics.snapshot()["kernel"].values())
+        assert reasons, "auto int8 recorded no admission decisions"
+        if not admitted:        # the only possibility off-TPU
+            assert got == ref
+            assert any("measured win" in r for r in reasons.values())
+
+    def test_unknown_admission_env_is_a_typed_error(self):
+        from iwae_replication_project_tpu.ops.hot_loop import (
+            serving_int8_admit)
+
+        saved = os.environ.get("IWAE_SERVING_INT8")
+        os.environ["IWAE_SERVING_INT8"] = "sometimes"
+        try:
+            with pytest.raises(ValueError, match="IWAE_SERVING_INT8"):
+                serving_int8_admit(3, 4, 8, 8, 16, on_tpu=False)
+        finally:
+            if saved is None:
+                os.environ.pop("IWAE_SERVING_INT8", None)
+            else:
+                os.environ["IWAE_SERVING_INT8"] = saved
+
+
+# ---------------------------------------------------------------------------
+# wire: precision is validated + asserted per request, declared in info
+# ---------------------------------------------------------------------------
+
+class PrecisionFakeEngine:
+    """Minimal engine surface with model + precision labels (no device):
+    the wire contract under test is validation/declaration, not math."""
+
+    def __init__(self, model, precision=None, dims=4):
+        self.model = model
+        self.models = frozenset({model})
+        self.row_dims = {"score": dims}
+        self.k = 5
+        self.precision = precision
+
+    def submit(self, op, row, k=None, *, seed=None, model=None):
+        f = Future()
+        f.set_result(float(sum(row)))
+        return f
+
+    def start(self):
+        pass
+
+    def stop(self, timeout_s=None):
+        pass
+
+    def warmup(self, ops=(), ks=None):
+        return {"programs": 0.0}
+
+
+def _raw_request(port, req):
+    """One request over a raw socket (TierClient has no precision kwarg:
+    the field under test is the wire schema itself)."""
+    from iwae_replication_project_tpu.serving.frontend import protocol
+
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+        s.sendall(protocol.encode_line(req))
+        buf = b""
+        while b"\n" not in buf:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return json.loads(buf.split(b"\n", 1)[0].decode())
+
+
+class TestWirePrecision:
+    def _tier(self):
+        from iwae_replication_project_tpu.serving.frontend import (
+            ServingTier)
+
+        tier = ServingTier([PrecisionFakeEngine("m-a"),
+                            PrecisionFakeEngine("m-b", precision="bf16")],
+                           port=0)
+        tier.start()
+        return tier
+
+    def test_precisions_for_reports_fleet_policies(self):
+        tier = self._tier()
+        try:
+            assert tier.precisions_for("m-a") == {"fp32"}
+            assert tier.precisions_for("m-b") == {"bf16"}
+        finally:
+            tier.stop(timeout_s=10)
+
+    def test_unknown_precision_is_bad_request_connection_survives(self):
+        tier = self._tier()
+        try:
+            resp = _raw_request(tier.port, {
+                "id": 1, "op": "score", "x": [1.0] * 4, "model": "m-a",
+                "precision": "fp16"})
+            assert resp["ok"] is False
+            assert resp["error"] == "bad_request"
+            assert "fp16" in resp["message"]
+            # vocabulary-valid but not held here: equally typed, with the
+            # held set in the message — never a silent serve
+            resp = _raw_request(tier.port, {
+                "id": 2, "op": "score", "x": [1.0] * 4, "model": "m-a",
+                "precision": "int8"})
+            assert resp["ok"] is False
+            assert resp["error"] == "bad_request"
+            assert "not served at precision" in resp["message"]
+            assert "fp32" in resp["message"]
+        finally:
+            tier.stop(timeout_s=10)
+
+    def test_matching_precision_assertion_serves(self):
+        tier = self._tier()
+        try:
+            for model, precision in (("m-a", "fp32"), ("m-b", "bf16")):
+                resp = _raw_request(tier.port, {
+                    "id": 1, "op": "score", "x": [1.0] * 4,
+                    "model": model, "precision": precision})
+                assert resp["ok"] is True, resp
+        finally:
+            tier.stop(timeout_s=10)
+
+    def test_info_declares_per_model_precision(self):
+        tier = self._tier()
+        try:
+            models = tier.info()["models"]
+            assert models["m-a"]["precision"] == "fp32"
+            assert models["m-b"]["precision"] == "bf16"
+        finally:
+            tier.stop(timeout_s=10)

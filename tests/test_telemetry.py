@@ -360,6 +360,40 @@ class TestExporters:
         page = prometheus_text(m.registry)
         assert 'iwae_latency_zoo_x_score_b4{quantile="0.5"}' in page
 
+    def test_precision_labeled_schema(self):
+        """Under a serving precision policy (ISSUE 16) every metric
+        surface grows the precision dimension — ``<model>@<precision>``
+        histogram labels matching the engine's store label, a
+        ``/<precision>``-suffixed kernel stamp key carrying a
+        ``precision`` field, a ``precision`` snapshot key, and the
+        Prometheus spelling — while ``precision=None`` keeps the schema
+        byte-identical to a pre-precision fleet."""
+        from iwae_replication_project_tpu.serving.metrics import (
+            ServingMetrics)
+
+        m = ServingMetrics(model="zoo-x", precision="bf16")
+        m.record_latency("score", 4, 0.004)
+        m.set_kernel("score", 3, 4, 1, "fused", None)
+        snap = m.snapshot()
+        assert snap["precision"] == "bf16"
+        assert "zoo-x@bf16/score/b4" in snap["latency"]
+        assert snap["kernel"]["score/b4/k3/bf16"]["precision"] == "bf16"
+        assert m.flat()["latency/zoo-x@bf16/score/b4/count"] == 1.0
+        page = prometheus_text(m.registry)
+        assert 'iwae_latency_zoo_x_bf16_score_b4{quantile="0.5"}' in page
+
+        # the fp32-only contract: no policy -> no "precision" key, the
+        # historical kernel key, the historical latency label
+        base = ServingMetrics(model="zoo-x")
+        base.record_latency("score", 4, 0.004)
+        base.set_kernel("score", 3, 4, 1, "fused", None)
+        bsnap = base.snapshot()
+        assert "precision" not in bsnap
+        assert "zoo-x/score/b4" in bsnap["latency"]
+        assert "score/b4/k3" in bsnap["kernel"]
+        assert "precision" not in bsnap["kernel"]["score/b4/k3"]
+        assert sorted(bsnap) == sorted(set(snap) - {"precision"})
+
 
 # ---------------------------------------------------------------------------
 # request tracing: context, flight recorder, wire round-trip
