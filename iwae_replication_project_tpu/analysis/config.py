@@ -76,6 +76,18 @@ def _default_concurrency_paths() -> List[str]:
             "iwae_replication_project_tpu/utils/faults.py"]
 
 
+def _default_leak_paths() -> List[str]:
+    # files the static leak pass (leaked-future / leaked-span / leaked-pin,
+    # analysis/race/leaks.py) proves release-shapes over: the serving
+    # control plane that acquires futures, tracing spans, and executable-
+    # store pins on the request path
+    return ["iwae_replication_project_tpu/serving/engine.py",
+            "iwae_replication_project_tpu/serving/batcher.py",
+            "iwae_replication_project_tpu/serving/sharded.py",
+            "iwae_replication_project_tpu/serving/frontend",
+            "iwae_replication_project_tpu/telemetry/tracing.py"]
+
+
 def _default_fragile_imports() -> List[str]:
     # modules whose import location / signature moved across jax releases;
     # PR 1's seed breakage ('from jax import shard_map' on jax 0.4.37, six
@@ -115,6 +127,9 @@ class LintConfig:
     #: files the lock-order / unlocked-shared-state rules analyze
     concurrency_paths: List[str] = dataclasses.field(
         default_factory=_default_concurrency_paths)
+    #: files the static leak pass (leaked-future/span/pin) analyzes
+    leak_paths: List[str] = dataclasses.field(
+        default_factory=_default_leak_paths)
     #: repo root all relative paths above resolve against
     root: Optional[str] = None
 

@@ -10,3 +10,7 @@ from iwae_replication_project_tpu.analysis.rules import (  # noqa: F401
     jit,
     prng,
 )
+# the static leak pass (leaked-future / leaked-span / leaked-pin) lives in
+# the race-detector package but registers with the same rule registry so
+# suppressions and --select work uniformly across iwae-lint and iwae-race
+from iwae_replication_project_tpu.analysis.race import leaks  # noqa: F401,E402

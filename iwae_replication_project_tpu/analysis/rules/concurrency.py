@@ -1,5 +1,6 @@
-"""Rules ``lock-order``, ``unlocked-shared-state``, and
-``swallowed-exception``: the serving concurrency checker.
+"""Rules ``lock-order``, ``unlocked-shared-state``,
+``blocking-call-under-lock``, and ``swallowed-exception``: the serving
+concurrency checker.
 
 The serving engine is a three-thread system — the dispatcher coalesces and
 enqueues, the completion thread fetches and completes, and metric scrapes
@@ -29,7 +30,24 @@ Model (deliberately scoped to this codebase's locking idiom):
   a mutating method call ``self.Y.append/pop/...(...)``) is **guarded** when
   it executes under any ``with self.<lock>``; an attribute with both guarded
   and bare writes outside ``__init__`` gets an ``unlocked-shared-state``
-  finding at each bare site.
+  finding at each bare site — UNLESS the thread-escape analysis
+  (``analysis/race/escape.py``) proves the attribute **thread-confined**
+  (every access lands in exactly one internal thread root), in which case
+  the mixed regime cannot race and no waiver is needed;
+* conversely, an attribute the escape analysis proves **escaping** (its
+  accesses span two or more thread roots, or it is handed off through a
+  queue/future/thread-args payload) whose writes are *never* guarded is
+  flagged too — even in a class with no lock anywhere, which the
+  lock-relative rule alone cannot see. Writes in lifecycle methods (those
+  that call ``.start()`` or ``.join()``) are exempt: the thread start/join
+  edge happens-before-orders them;
+* ``blocking-call-under-lock``: a call that can block indefinitely —
+  ``future.result()``, socket send/recv/accept/connect, ``queue.get/put``
+  with no timeout, ``time.sleep``, a thread ``.join()``, an event
+  ``.wait()`` — made while holding a ``with self.<lock>`` stalls every
+  thread contending for that lock (and under the engine's completion/
+  dispatch triangle, stalls the whole tier). Checked directly and one
+  level through same-class calls made under a lock.
 
 ``swallowed-exception`` adds the third failure class of a callback-driven
 serving stack: an ``except`` handler that drops the error on the floor. In
@@ -43,6 +61,13 @@ exception value* (``except X as e`` with ``e`` flowing into a completion
 call, a typed response, or a message). A deliberate best-effort drop
 (``sock.shutdown`` on teardown) carries a justified suppression — the
 inventory of intentional swallows stays reviewable in the diff.
+
+One shape is exempt without a waiver: ``except OSError`` whose body only
+sets a constant flag or passes, inside a function the static leak pass
+(``analysis/race/leaks.py``) proves acquisition-free — such a teardown
+drop cannot leak a future, span, or pin, so demanding a justification
+adds review noise, not safety (the PR-10 suppression inventory re-audit
+retired four waivers through exactly this verdict).
 ``contextlib.suppress(...)`` is the OTHER sanctioned idiom: it cannot
 contain logic, so it is intentional by construction (and greppable); the
 rule deliberately leaves it alone rather than demanding a second marker.
@@ -59,6 +84,8 @@ from iwae_replication_project_tpu.analysis.core import (
     Rule,
     register,
 )
+from iwae_replication_project_tpu.analysis.race import escape as _escape
+from iwae_replication_project_tpu.analysis.race.leaks import acquisitions_in
 
 #: threading factory callables whose result is a lockable
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
@@ -68,6 +95,15 @@ _LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
 _MUTATORS = {"append", "appendleft", "extend", "insert", "pop", "popleft",
              "remove", "clear", "update", "add", "discard", "setdefault",
              "sort", "reverse"}
+
+#: socket methods that block on the peer / the kernel
+_SOCKET_BLOCKERS = {"send", "sendall", "recv", "recv_into", "accept",
+                    "connect", "sendto", "recvfrom", "makefile"}
+
+#: receiver spellings the queue get/put heuristic treats as queues
+def _queueish(recv_name: str) -> bool:
+    last = recv_name.rsplit(".", 1)[-1].lower().lstrip("_")
+    return "queue" in last or last == "q" or last.endswith("_q")
 
 
 def _self_attr(node: ast.AST) -> Optional[str]:
@@ -81,6 +117,53 @@ def _self_attr(node: ast.AST) -> Optional[str]:
 def _in_paths(ctx: FileContext, paths: List[str]) -> bool:
     return any(ctx.rel_path == p or ctx.rel_path.startswith(p.rstrip("/") + "/")
                for p in paths)
+
+
+def _blocking_what(node: ast.Call, locks: Dict[str, str]) -> Optional[str]:
+    """A short description when `node` is a potentially-unbounded blocking
+    call, else None. Scoped to the blockers this codebase can actually
+    reach: future results, socket I/O, un-timeouted queue ops, sleeps,
+    thread joins, and event waits (a Condition's own wait releases the
+    lock it is called under, so lock-attr receivers are exempt)."""
+    if not isinstance(node.func, ast.Attribute):
+        name = Rule.call_name(node)
+        return "time.sleep()" if Rule.terminal(name) == "sleep" else None
+    meth = node.func.attr
+    dotted = Rule.call_name(node)          # '' for non-name receiver chains
+    recv = dotted.rsplit(".", 1)[0] if "." in dotted else ""
+    kwargs = {kw.arg for kw in node.keywords}
+    if meth == "sleep":
+        return "time.sleep()"
+    if meth == "result":
+        return "future .result()"
+    if meth in _SOCKET_BLOCKERS:
+        return f"socket .{meth}()"
+    if meth == "join":
+        numeric = (len(node.args) == 1 and
+                   isinstance(node.args[0], ast.Constant) and
+                   isinstance(node.args[0].value, (int, float)))
+        if not node.args and (not kwargs or kwargs == {"timeout"}):
+            return "thread .join()"
+        if numeric:
+            return "thread .join()"
+        return None                        # str.join(iterable) etc.
+    if meth in ("get", "put") and _queueish(recv):
+        if "timeout" in kwargs or len(node.args) >= 2:
+            return None
+        for kw in node.keywords:
+            if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return None
+        if node.args and meth == "get" and \
+                isinstance(node.args[0], ast.Constant) and \
+                node.args[0].value is False:
+            return None
+        return f"queue .{meth}() with no timeout"
+    if meth == "wait":
+        attr = _self_attr(node.func.value)
+        if attr is not None and attr not in locks:
+            return "event .wait()"
+    return None
 
 
 def _lock_attrs(cls: ast.ClassDef) -> Dict[str, str]:
@@ -121,8 +204,13 @@ class _FuncWalk(ast.NodeVisitor):
         self.acquired: Dict[str, ast.AST] = {}
         #: attr -> [(guarded?, node)]
         self.writes: Dict[str, List[Tuple[bool, ast.AST]]] = {}
-        #: (held_lock, method_name) calls for one-level interprocedural edges
-        self.calls_under_lock: List[Tuple[str, str]] = []
+        #: (held_lock, method_name, call node) for one-level interprocedural
+        self.calls_under_lock: List[Tuple[str, str, ast.AST]] = []
+        #: (held?, node, what) for potentially-unbounded blocking calls
+        self.blocking: List[Tuple[bool, ast.AST, str]] = []
+        #: calls .start()/.join(): a thread lifecycle method — its bare
+        #: writes are ordered by the start/join happens-before edge
+        self.lifecycle = False
 
     def _record_write(self, attr: str, node: ast.AST) -> None:
         self.writes.setdefault(attr, []).append((bool(self.held), node))
@@ -171,7 +259,12 @@ class _FuncWalk(ast.NodeVisitor):
                 self._record_write(attr, node)
             if isinstance(recv, ast.Name) and recv.id == "self" and self.held:
                 for held in self.held:
-                    self.calls_under_lock.append((held, node.func.attr))
+                    self.calls_under_lock.append((held, node.func.attr, node))
+            if node.func.attr in ("start", "join"):
+                self.lifecycle = True
+        what = _blocking_what(node, self.locks)
+        if what is not None:
+            self.blocking.append((bool(self.held), node, what))
         self.generic_visit(node)
 
 
@@ -229,7 +322,7 @@ class LockOrderRule(Rule):
             for w in walks.values():
                 for held, got, node in w.edges:
                     edges.setdefault((held, got), node)
-                for held, meth in w.calls_under_lock:
+                for held, meth, _ in w.calls_under_lock:
                     callee = walks.get(meth)
                     if callee is None:
                         continue
@@ -263,6 +356,36 @@ class LockOrderRule(Rule):
                         f"concurrently deadlock; pick one global order")
 
 
+def _teardown_drop(handler: ast.ExceptHandler) -> bool:
+    """An ``except OSError`` whose body only passes or sets a constant flag
+    (``self._dead = True``) — the best-effort-teardown shape. Exempt from
+    ``swallowed-exception`` when the enclosing function is acquisition-free
+    per the static leak pass (nothing a dropped error could leak)."""
+    if handler.type is None or \
+            Rule.terminal(Rule.dotted(handler.type) or "?") != "OSError":
+        return False
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.value, ast.Constant) and \
+                _self_attr(stmt.targets[0]) is not None:
+            continue
+        return False
+    return True
+
+
+def _handler_funcs(tree: ast.Module) -> Dict[ast.ExceptHandler, ast.AST]:
+    """Each except handler -> its innermost enclosing function."""
+    out: Dict[ast.ExceptHandler, ast.AST] = {}
+    for func in [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        for node in ast.walk(func):
+            if isinstance(node, ast.ExceptHandler):
+                out[node] = func        # inner functions visited later win
+    return out
+
+
 def _handler_handles(handler: ast.ExceptHandler) -> bool:
     """Whether the handler's body re-raises, makes an explicit control-flow
     decision (return/continue/break), or uses the bound exception value —
@@ -290,11 +413,16 @@ class SwallowedExceptionRule(Rule):
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         if not _in_paths(ctx, ctx.config.concurrency_paths):
             return
+        funcs = _handler_funcs(ctx.tree)
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.ExceptHandler):
                 continue
             if _handler_handles(node):
                 continue
+            func = funcs.get(node)
+            if func is not None and _teardown_drop(node) and \
+                    acquisitions_in(func) == 0:
+                continue    # leak-pass verdict: this drop cannot leak
             caught = (Rule.dotted(node.type) or "...") \
                 if node.type is not None else "BaseException"
             yield ctx.finding(
@@ -309,9 +437,71 @@ class SwallowedExceptionRule(Rule):
 @register
 class UnlockedSharedStateRule(Rule):
     name = "unlocked-shared-state"
-    summary = ("attribute written both under a lock and bare in a "
-               "concurrency_paths class — the bare write races the guarded "
-               "readers/writers")
+    summary = ("attribute written bare in a concurrency_paths class where "
+               "it can race: mixed guarded/bare writes, or never-guarded "
+               "writes to state the escape analysis proves crosses a "
+               "thread boundary")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        if not _in_paths(ctx, ctx.config.concurrency_paths):
+            return
+        init_names = ("__init__", "__post_init__")
+        for cls in [n for n in ast.walk(ctx.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            locks, walks = _analyze_class(cls)
+            esc = _escape.classify_class(cls, skip_attrs=set(locks))
+            guarded: Set[str] = set()
+            for name, w in walks.items():
+                if name in init_names:
+                    continue
+                for attr, sites in w.writes.items():
+                    if any(g for g, _ in sites):
+                        guarded.add(attr)
+            for name, w in walks.items():
+                if name in init_names:
+                    continue
+                for attr, sites in w.writes.items():
+                    # mixed regime: guarded elsewhere, bare here — unless
+                    # the attribute never leaves one internal thread
+                    if attr in guarded:
+                        if esc.confined(attr):
+                            continue
+                        for g, node in sites:
+                            if not g:
+                                yield ctx.finding(
+                                    self.name, node,
+                                    f"'{cls.name}.{attr}' is written under "
+                                    f"a lock elsewhere but bare in '{name}'"
+                                    f" — either every write holds the lock "
+                                    f"or none does; a mixed regime "
+                                    f"publishes torn/stale state to the "
+                                    f"guarded threads")
+                        continue
+                    # never guarded anywhere: flag only when the escape
+                    # analysis proves the attribute crosses a thread
+                    # boundary (lifecycle methods are start/join-ordered)
+                    if w.lifecycle or not esc.escaping(attr):
+                        continue
+                    roots = ", ".join(sorted(esc.roots_of(attr)))
+                    for g, node in sites:
+                        yield ctx.finding(
+                            self.name, node,
+                            f"'{cls.name}.{attr}' is written in '{name}' "
+                            f"with no lock held anywhere, but escapes to "
+                            f"multiple thread roots ({roots}) — a bare "
+                            f"write to thread-escaping state races every "
+                            f"other root; guard it or confine it to one "
+                            f"thread")
+
+
+@register
+class BlockingCallUnderLockRule(Rule):
+    name = "blocking-call-under-lock"
+    summary = ("a potentially-unbounded blocking call (future .result(), "
+               "socket I/O, un-timeouted queue get/put, time.sleep, thread "
+               ".join, event .wait) made while holding a lock in a "
+               "concurrency_paths class — every thread contending for that "
+               "lock stalls behind the peer/kernel/scheduler")
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
         if not _in_paths(ctx, ctx.config.concurrency_paths):
@@ -320,26 +510,31 @@ class UnlockedSharedStateRule(Rule):
                     if isinstance(n, ast.ClassDef)]:
             locks, walks = _analyze_class(cls)
             if not locks:
-                continue  # lock-free classes are synchronized by their owner
-            guarded: Set[str] = set()
+                continue
             for name, w in walks.items():
-                if name in ("__init__", "__post_init__"):
-                    continue
-                for attr, sites in w.writes.items():
-                    if any(g for g, _ in sites):
-                        guarded.add(attr)
-            for name, w in walks.items():
-                if name in ("__init__", "__post_init__"):
-                    continue
-                for attr, sites in w.writes.items():
-                    if attr not in guarded:
+                for held, node, what in w.blocking:
+                    if held:
+                        yield ctx.finding(
+                            self.name, node,
+                            f"'{cls.name}.{name}' makes a blocking {what} "
+                            f"while holding a lock — move the blocking op "
+                            f"outside the critical section (snapshot under "
+                            f"the lock, block outside), or bound it with a "
+                            f"timeout")
+                # one level interprocedural: a held-lock call into a method
+                # that blocks (in its own unheld context) blocks here too
+                for held, meth, node in w.calls_under_lock:
+                    callee = walks.get(meth)
+                    if callee is None:
                         continue
-                    for g, node in sites:
-                        if not g:
+                    for c_held, _, what in callee.blocking:
+                        if not c_held:
                             yield ctx.finding(
                                 self.name, node,
-                                f"'{cls.name}.{attr}' is written under a "
-                                f"lock elsewhere but bare in '{name}' — "
-                                f"either every write holds the lock or none "
-                                f"does; a mixed regime publishes torn/stale "
-                                f"state to the guarded threads")
+                                f"'{cls.name}.{name}' calls '{meth}' while "
+                                f"holding lock '{held}', and '{meth}' makes "
+                                f"a blocking {what} — the lock is held "
+                                f"across the block; move the call outside "
+                                f"the critical section or bound it with a "
+                                f"timeout")
+                            break

@@ -502,7 +502,7 @@ class ServingEngine:
         device->host fetch in dispatch order, slice padding, complete
         futures. Exits only once stopped AND the window has drained."""
         while True:
-            inf = self._window.pop(stop=self._completion_stop.is_set)
+            inf = self._window.pop(stop=self._completion_stop.is_set)  # iwaelint: disable=unlocked-shared-state -- _window is an InflightWindow monitor (internally locked); .pop is its blocking dequeue, not a bare container mutation
             if inf is None:
                 return
             self._finish(inf)
@@ -536,7 +536,7 @@ class ServingEngine:
         hit = self._kernel_cache.get(key)
         if hit is None:
             hit = self._resolve_kernel(op, k, bucket)
-            self._kernel_cache[key] = hit
+            self._kernel_cache[key] = hit  # iwaelint: disable=unlocked-shared-state -- idempotent memo publish: the value is a pure function of the key, dict setitem is atomic under the GIL, and a double resolution is benign (both writers store the identical tuple)
         return hit
 
     def _resolve_kernel(self, op: str, k: int, bucket: int) -> tuple:
@@ -564,7 +564,7 @@ class ServingEngine:
             h1_dim, hid, n_pixels = dims_for_model(self.cfg)
             admitted, reason = serving_int8_admit(k, bucket, h1_dim, hid,
                                                   n_pixels, on_tpu=_on_tpu())
-            self.int8_admission[(op, k, bucket)] = reason
+            self.int8_admission[(op, k, bucket)] = reason  # iwaelint: disable=unlocked-shared-state -- idempotent telemetry memo: the admission reason is a pure function of the key; racing writers store the identical string
             if admitted:
                 return self.cfg, "int8", None
         return serving_dispatch_config(self.cfg, k, bucket,

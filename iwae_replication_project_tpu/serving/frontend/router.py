@@ -129,6 +129,14 @@ class _Tracked:
     attempts: int = 0
     replica_index: int = -1
     t_dispatch: float = 0.0
+    #: True while the dispatching thread is in the submit window — between
+    #: placing the request on a replica and registering its done-callback,
+    #: which runs OUTSIDE the router lock (engine.submit may block). A
+    #: failure drain must not steal a request in this window: the
+    #: dispatcher is still touching it, and stealing double-dispatches
+    #: (two threads rerouting one request, both mutating its span and
+    #: attempt count). Written only under the router lock.
+    submitting: bool = False
     #: set (under the router lock) exactly once, when the tier future is
     #: completed — guards the outstanding-count decrement against the
     #: duplicate completions rerouting can produce
@@ -476,6 +484,7 @@ class ReplicaRouter:
                 t.replica_index = r.index
                 t.attempts += 1
                 t.t_dispatch = self._clock()
+                t.submitting = True
                 self._publish_replica(r)
             if t.trace is not None:
                 # attempt-indexed child span: a rerouted request's tree
@@ -517,7 +526,23 @@ class ReplicaRouter:
                 continue
             ef.add_done_callback(
                 lambda f, t=t, r=r: self._on_engine_done(t, r, f))
-            return
+            with self._lock:
+                t.submitting = False
+                # the replica failed while we were in the submit window:
+                # the drain skipped this request (we still owned it) — if
+                # the engine future's callback hasn't claimed it either,
+                # take the reroute ourselves, as a submit failure would
+                abandoned = not r.healthy and \
+                    r.outstanding.get(t.ticket) is t
+                if abandoned:
+                    del r.outstanding[t.ticket]
+                    self._publish_replica(r)
+            if not abandoned:
+                return
+            self._finish_span(t, RuntimeError(
+                f"replica r{r.index} failed during submit"))
+            exclude.add(r.index)
+            continue
         if any_shed:
             self._count("sheds")
             raise EngineOverloaded(
@@ -528,6 +553,7 @@ class ReplicaRouter:
     def _unplace(self, t: _Tracked, r: _Replica) -> None:
         with self._lock:
             r.outstanding.pop(t.ticket, None)
+            t.submitting = False
             self._publish_replica(r)
 
     def _redispatch(self, t: _Tracked, exclude: Set[int],
@@ -581,6 +607,9 @@ class ReplicaRouter:
             if owns:
                 del r.outstanding[t.ticket]
                 self._publish_replica(r)
+            finalized = t.finalized      # snapshot under the lock that
+            # guards the flag; a completion landing after the snapshot is
+            # deduplicated by _finalize itself
         exc = ef.exception()
         if exc is None:
             if owns:
@@ -590,7 +619,7 @@ class ReplicaRouter:
                 self._finish_span(t)
             self._finalize(t, result=ef.result())
             return
-        if not owns or t.finalized:
+        if not owns or finalized:
             return
         self._finish_span(t, exc)
         if isinstance(exc, RequestTimeout):
@@ -622,8 +651,14 @@ class ReplicaRouter:
             was_healthy = r.healthy
             r.healthy = False
             r.last_error = f"{type(exc).__name__}: {exc}"
-            drained = list(r.outstanding.values())
-            r.outstanding.clear()
+            # steal only fully-dispatched requests: one still in its submit
+            # window belongs to the dispatching thread, which will observe
+            # the unhealthy flag (or a submit failure / the errored engine
+            # future) and reroute it itself — stealing it here would
+            # double-dispatch it
+            drained = [t for t in r.outstanding.values() if not t.submitting]
+            for t in drained:
+                del r.outstanding[t.ticket]
             self._publish_replica(r)
         if was_healthy:
             self._count("replica_failures")

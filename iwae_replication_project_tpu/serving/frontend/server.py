@@ -103,10 +103,12 @@ class _Connection:
             # fails exactly like a real peer reset
             fault_point(SITE_TIER_WRITE, sock=self._sock, conn=self)
             try:
-                self._sock.sendall(data)
-            except OSError:  # iwaelint: disable=swallowed-exception -- deliberate: the client vanished and the response has no destination; _dead is the handled signal and the read loop retires the connection
+                self._sock.sendall(data)  # iwaelint: disable=blocking-call-under-lock -- the per-connection write lock IS the frame serializer: concurrent responses interleaving on one socket would corrupt the line protocol; a dead peer fails fast with OSError rather than stalling
+            except OSError:
                 # the client vanished; the response was produced — nothing
                 # to deliver it to. Reads will fail and retire the loop.
+                # (no swallowed-exception waiver needed: the leak pass
+                # proves _write acquisition-free, so the drop cannot leak)
                 self._dead = True
 
     def _respond_error(self, req_id: Any, exc: BaseException) -> None:
@@ -124,12 +126,15 @@ class _Connection:
     # -- request handling (read-loop thread + future callbacks) -------------
 
     def _row_done(self, pending: _Pending, i: int, fut) -> None:
+        # the callback fires on an already-completed future, so exception()
+        # and result() return immediately — but they are *blocking* calls
+        # by contract, so both stay outside the connection lock
         exc = fut.exception()
+        r = fut.result() if exc is None else None
         with self._lock:
             if exc is not None and pending.error is None:
                 pending.error = exc
             elif exc is None:
-                r = fut.result()
                 pending.results[i] = r.tolist() if hasattr(r, "tolist") else r
             pending.remaining -= 1
             finished = pending.remaining == 0
@@ -308,7 +313,10 @@ class _Connection:
             self._dead = True
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:  # iwaelint: disable=swallowed-exception -- best-effort shutdown of a possibly already-dead peer socket; close() below is the real teardown
+        except OSError:
+            # best-effort shutdown of a possibly already-dead peer socket;
+            # close() below is the real teardown (waiver retired: the leak
+            # pass proves close() acquisition-free)
             pass
         self._sock.close()
 

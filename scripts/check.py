@@ -5,58 +5,68 @@ machine-readable summary.
 
 1. **iwaelint** over the production tree (``[tool.iwaelint]`` paths) — the
    AST rule suite (analysis/rules/), including the concurrency checker
-   (lock-order / unlocked-shared-state over the serving engine and the
-   metric registry) and the ``useless-suppression`` meta-rule;
-2. **iwae-audit** (analysis/audit/) — the jaxpr-level program auditor:
+   (lock-order / unlocked-shared-state / blocking-call-under-lock over
+   the serving engine and the metric registry) and the
+   ``useless-suppression`` meta-rule;
+2. **iwae-race** (analysis/race/) — the static future/span/pin leak pass
+   over the serving control plane (every acquisition provably completed/
+   finished/released on all exception paths) plus the lockset +
+   happens-before race detector's seeded self-test battery;
+3. **iwae-audit** (analysis/audit/) — the jaxpr-level program auditor:
    donation safety, padding taint, in-graph host transfers, and recompile
    cardinality over the repo's real traced programs (train step, k=5000
    eval scorer, the three serving programs, all hot-loop paths);
-3. **iwae-cost** (analysis/audit/cost.py) — the jaxpr-level cost analyzer
+4. **iwae-cost** (analysis/audit/cost.py) — the jaxpr-level cost analyzer
    over the same traced suite: live-range peak HBM bytes, FLOP/byte
    roofline accounting, and per-mesh-axis collective profiles, writing
    the committed ``results/cost_report.json`` (memory-blowup and
    accidental-allgather findings fail the gate like lint findings);
-4. **telemetry smoke** (scripts/telemetry_smoke.py);
-5. **serving smoke** (scripts/serving_smoke.py);
-6. **serving tier smoke** (scripts/serving_tier_smoke.py) — the network
+5. **telemetry smoke** (scripts/telemetry_smoke.py);
+6. **serving smoke** (scripts/serving_smoke.py);
+7. **serving tier smoke** (scripts/serving_tier_smoke.py) — the network
    tier over a real socket with a replica killed mid-burst: zero lost
    responses, zero recompiles, bitwise parity with a direct engine;
-7. **large-k smoke** (scripts/large_k_smoke.py) — a k=5000 score request
+8. **large-k smoke** (scripts/large_k_smoke.py) — a k=5000 score request
    through the warm mesh-backed engine: bitwise parity with the offline
    ``parallel/eval`` scorer and zero recompiles over a ragged (batch, k)
    stream;
-8. **hot-loop smoke** (scripts/hot_loop_smoke.py);
-9. **autotune smoke** (scripts/autotune_smoke.py) — a real tiny tile/remat
+9. **hot-loop smoke** (scripts/hot_loop_smoke.py);
+10. **autotune smoke** (scripts/autotune_smoke.py) — a real tiny tile/remat
    search with the warm-cache (zero probe compiles) contract, winner-cache
    round-trip/corruption fallback, and fused-vs-reference serving parity
    through the lifted engine gate;
-10. **chaos smoke** (scripts/chaos_smoke.py) — the failure model under a
+11. **chaos smoke** (scripts/chaos_smoke.py) — the failure model under a
    seeded fault schedule: replica crash + AOT fault + dropped connection
    vs a retrying client (bitwise parity, zero lost futures), a slow
    replica beaten by a client hedge, SIGTERM-mid-stage + resume and
    truncated-checkpoint fallback both bitwise-identical to an
    uninterrupted run; summary committed to ``results/chaos_smoke.json``;
-11. **multi-model smoke** (scripts/multi_model_smoke.py) — a two-model zoo
+12. **multi-model smoke** (scripts/multi_model_smoke.py) — a two-model zoo
    behind one tier over a real socket with the executable-store budget
    squeezed to one model's worth: forced eviction churn mid-burst, every
    response bitwise-correct vs dedicated single-model engines, zero
    fresh compiles once warm (evictions demote to the persistent cache
    and readmit by deserialization);
-12. **precision parity smoke** (scripts/precision_parity_smoke.py) — the
+13. **precision parity smoke** (scripts/precision_parity_smoke.py) — the
    low-precision serving contract: bf16/int8 legs pass the statistical
    acceptance gate (telemetry/parity.py) while a corrupted leg is
    rejected, explicit-fp32 policy stays bitwise, one tier serves fp32 +
    bf16 tenants of the same model with zero fresh compiles once warm,
    and int8 admission is honest (forced path stamps ``int8``; auto with
    no measured win serves the exact fp32 program);
-13. **trace smoke** (scripts/trace_smoke.py) — end-to-end request tracing
+14. **trace smoke** (scripts/trace_smoke.py) — end-to-end request tracing
    over a real socket: a ragged burst with a replica killed mid-burst
    plus a hedged request, every request yielding ONE coherent trace tree
    (client -> tier -> router attempts -> engine stages) in the
    tail-sampled flight recorder, results bitwise identical to a
    tracing-off tier, the ``traces`` wire op valid in raw and Chrome
    formats, and SLO burn-rate gauges live on the Prometheus page;
-14. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
+15. **race smoke** (scripts/race_smoke.py) — the race detector's
+   instrumented-sync layer over the REAL tier/router/engine stack under
+   >= 50 seeded perturbation schedules with a replica killed mid-burst:
+   zero races, zero runtime leaks (open spans, store pins, undone
+   futures), and results bitwise identical to an uninstrumented run;
+16. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
    ``--sanitize`` armed.
 
 Every full-gate run writes ``results/check_summary.json`` (per-stage status,
@@ -167,6 +177,16 @@ def run_audit() -> dict:
     return run_analyzer("audit", "iwae_replication_project_tpu.analysis.audit")
 
 
+def run_race() -> dict:
+    """The iwae-race stage: the static future/span/pin leak pass over the
+    serving control plane, plus the lockset+happens-before detector's
+    self-test battery (exit 2 — internal-error — when the battery fails:
+    a broken detector must not pose as a clean or findings run)."""
+    return run_analyzer(
+        "race", "iwae_replication_project_tpu.analysis.race",
+        extra_args=("--self-test",))
+
+
 def run_cost() -> dict:
     """The iwae-cost stage: same exit-code classification as lint/audit
     (0 clean / 1 findings / anything else = analyzer crash), plus the
@@ -238,6 +258,12 @@ def run_trace_smoke() -> dict:
                                                   "trace_smoke.py")])
 
 
+def run_race_smoke() -> dict:
+    return run_step("race smoke",
+                    [sys.executable, os.path.join("scripts",
+                                                  "race_smoke.py")])
+
+
 def run_tests(extra) -> dict:
     return run_step("tier-1 tests", [
         sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
@@ -260,7 +286,7 @@ def main(argv=None) -> int:
         argv, passthrough = argv[:split], argv[split + 1:]
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--lint-only", action="store_true",
-                    help="static analyzers only (lint + audit + cost)")
+                    help="static analyzers only (lint + race + audit + cost)")
     ap.add_argument("--tests-only", action="store_true")
     ap.add_argument("--summary", default=None,
                     help="where to write the machine-readable stage summary "
@@ -273,6 +299,7 @@ def main(argv=None) -> int:
     stages = []
     if not args.tests_only:
         stages.append(run_lint())
+        stages.append(run_race())
         stages.append(run_audit())
         stages.append(run_cost())
     if not single_stage:
@@ -286,6 +313,7 @@ def main(argv=None) -> int:
         stages.append(run_multi_model_smoke())
         stages.append(run_precision_parity_smoke())
         stages.append(run_trace_smoke())
+        stages.append(run_race_smoke())
     if not args.lint_only:
         stages.append(run_tests(passthrough))
 

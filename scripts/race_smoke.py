@@ -1,0 +1,284 @@
+"""Race-detector smoke stage for scripts/check.py.
+
+The ``analysis/race`` instrumented-sync layer over the REAL serving stack
+— tier + router + engines over a TCP socket — under seeded perturbation
+schedules (``PerturbFuzzer``: the utils/faults.py seeded-schedule idiom),
+with a replica killed mid-burst in every round. Four contracts:
+
+1. **race-clean** — across >= 50 fuzzed schedules the lockset +
+   happens-before detector records ZERO races on the serving classes'
+   instance-attribute traffic (engine, batcher, inflight window, router,
+   replicas, tier, connections, quotas). Any report carries the seed that
+   reproduces its schedule;
+2. **leak-clean at runtime** — after every round's drain: zero open spans
+   in the flight recorder, zero pinned executable-store entries, zero
+   outstanding router futures (the static leak pass proves the release
+   SHAPES exist; this proves they fire under fuzzed schedules);
+3. **bitwise parity** — every round's responses, kills and reroutes
+   included, are bitwise identical to an uninstrumented direct-engine
+   run of the same rows (instrumentation observes, never perturbs
+   results);
+4. **clean uninstall** — after the sweep, every patched module global and
+   class hook is the exact original object (the instrumentation-off tier
+   byte-matches the reference too): zero overhead when off.
+
+Scope note: the attribute tracer sees instance-attribute slots (binds,
+rebinds, augmented counters) — the torn-flag/lost-counter race class.
+Container *content* mutations (``self._pending[id] = f``) go through the
+container object, not ``__setattr__``, and are covered by the lockset on
+the reads/writes around them plus the queue/future HB edges.
+
+Exit 0 on success, 1 with the reproducing seed on the first failure.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: >= 50 seeded schedules (the acceptance floor)
+N_SCHEDULES = 50
+#: rows per fuzzed burst (small on purpose: the fuzz sweep buys coverage
+#: from schedule diversity, not burst size; the tier smoke owns load)
+SIZES = (1, 3, 2, 4)
+D = 32
+
+
+class KillableReplica:
+    """Engine proxy with an induced-death switch (the serving_tier_smoke
+    fault injector): ``kill()`` errors in-flight futures and refuses new
+    submits — the router must mark it unhealthy and reroute."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.row_dims = engine.row_dims
+        self.k = engine.k
+        self._lock = threading.Lock()
+        self._live = []
+        self.killed = False
+        self.submitted = 0
+
+    def submit(self, op, row, k=None, *, seed=None):
+        with self._lock:
+            if self.killed:
+                raise RuntimeError("replica killed (smoke fault injection)")
+        f = self.engine.submit(op, row, k=k, seed=seed)
+        with self._lock:
+            self._live.append(f)
+            self.submitted += 1
+        return f
+
+    def kill(self):
+        with self._lock:
+            self.killed = True
+            live, self._live = self._live, []
+        for f in live:
+            try:
+                f.set_exception(
+                    RuntimeError("replica killed (smoke fault injection)"))
+            except Exception:
+                pass        # already completed: nothing in flight to lose
+
+    def start(self):
+        self.engine.start()
+
+    def stop(self, timeout_s=60.0):
+        self.engine.stop()
+
+    def warmup(self, ops=(), ks=None):
+        return self.engine.warmup(ops=tuple(ops), ks=ks)
+
+
+def _burst(tier_port, x, sizes, victim, recorder):
+    """One ragged burst through a real socket with a mid-burst kill;
+    returns the responses keyed by request id, in submit order."""
+    from iwae_replication_project_tpu.serving.frontend import TierClient
+
+    with TierClient("127.0.0.1", tier_port, trace=True,
+                    recorder=recorder) as cli:
+        ids, off = [], 0
+        for i, n in enumerate(sizes):
+            ids.append(cli.submit("score", x[off:off + n].tolist()))
+            off += n
+            if i == len(sizes) // 2 and victim is not None:
+                deadline = time.monotonic() + 10.0
+                while victim.submitted == 0:
+                    assert time.monotonic() < deadline, \
+                        "victim replica never received work"
+                    time.sleep(0.002)
+                victim.kill()
+        responses = cli.drain(ids)
+    return [responses[rid] for rid in ids]
+
+
+def _snapshot_patchables(modules):
+    """(module, name, value) for every global the instrumentation can swap
+    — compared identically after uninstall (contract 4)."""
+    import queue as real_queue
+    import threading as real_threading
+    from concurrent.futures import Future as real_future
+
+    snap = []
+    for mod in modules:
+        for name, val in vars(mod).items():
+            if val is real_threading or val is real_queue \
+                    or val is real_future:
+                snap.append((mod, name, val))
+    return snap
+
+
+def main() -> int:
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        setup_persistent_cache)
+
+    setup_persistent_cache(base_dir=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+    import numpy as np
+
+    from iwae_replication_project_tpu.analysis.race import (
+        Instrumentation,
+        PerturbFuzzer,
+        RaceDetector,
+    )
+    from iwae_replication_project_tpu.models import iwae as model
+    from iwae_replication_project_tpu.serving import ServingEngine
+    from iwae_replication_project_tpu.serving import batcher as mod_batcher
+    from iwae_replication_project_tpu.serving import engine as mod_engine
+    from iwae_replication_project_tpu.serving.frontend import ServingTier
+    from iwae_replication_project_tpu.serving.frontend import (
+        client as mod_client)
+    from iwae_replication_project_tpu.serving.frontend import (
+        quotas as mod_quotas)
+    from iwae_replication_project_tpu.serving.frontend import (
+        router as mod_router)
+    from iwae_replication_project_tpu.serving.frontend import (
+        server as mod_server)
+    from iwae_replication_project_tpu.telemetry.tracing import FlightRecorder
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        executable_store)
+
+    cfg = model.ModelConfig(x_dim=D, n_hidden_enc=(16, 8),
+                            n_latent_enc=(8, 4), n_hidden_dec=(8, 16),
+                            n_latent_dec=(8, D))
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+
+    def engine():
+        return ServingEngine(params=params, model_config=cfg, k=4,
+                             max_batch=8, max_inflight=2, timeout_s=30.0)
+
+    rng = np.random.RandomState(0)
+    x = (rng.rand(sum(SIZES), D) > 0.5).astype(np.float32)
+
+    modules = (mod_engine, mod_batcher, mod_router, mod_server, mod_quotas,
+               mod_client)
+    tracked = (ServingEngine, mod_batcher.MicroBatcher,
+               mod_batcher.InflightWindow, mod_router.ReplicaRouter,
+               mod_router._Replica, mod_router._Tracked,
+               mod_server.ServingTier, mod_server._Connection,
+               mod_server._Pending, mod_quotas.ClientQuotas)
+    pre_snap = _snapshot_patchables(modules)
+
+    # -- the parity reference: ONE direct engine, uninstrumented ------------
+    direct = engine()
+    direct.warmup(ops=("score",))
+    ref = direct.score(x)
+    direct.stop()
+
+    def run_round(seed, instrumented):
+        """One tier burst (2 replicas, victim killed mid-burst). Returns
+        (results ndarray, detector report or None, leak verdict dict)."""
+        rec = FlightRecorder(capacity=64, sample_every=1)
+        ins = None
+        if instrumented:
+            det = RaceDetector(stack_depth=4)
+            fuzz = PerturbFuzzer(seed, rate=0.25, max_sleep_s=0.002)
+            ins = Instrumentation(detector=det, fuzz=fuzz)
+            ins.install(modules=modules, classes=tracked)
+        try:
+            victim = KillableReplica(engine())
+            tier = ServingTier([victim, engine()], port=0,
+                               monitor_interval_s=0.05, recorder=rec)
+            tier.warmup(ops=("score",))
+            tier.start()
+            responses = _burst(tier.port, x, SIZES, victim, rec)
+            tier.stop(timeout_s=30)
+            outstanding = tier.router.outstanding
+        finally:
+            if ins is not None:
+                ins.uninstall()
+        bad = [r for r in responses if not r["ok"]]
+        assert not bad, \
+            f"seed {seed}: requests failed despite a healthy peer: {bad[:2]}"
+        out = np.concatenate([np.asarray(r["result"], ref.dtype)
+                              for r in responses])
+        # spans finalize as futures complete; give stragglers a moment
+        deadline = time.monotonic() + 10.0
+        while rec.stats()["open"] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        leaks = {
+            "open_spans": rec.stats()["open"],
+            "pinned_entries": sum(1 for e in executable_store().entries()
+                                  if e["pinned"]),
+            "outstanding_futures": outstanding,
+        }
+        report = ins.det.report() if ins is not None else None
+        return out, report, leaks
+
+    # -- contract 3 baseline: an uninstrumented tier burst ------------------
+    out0, _, leaks0 = run_round(seed=-1, instrumented=False)
+    assert np.array_equal(out0, ref), \
+        "uninstrumented tier burst differs from the direct engine"
+    assert not any(leaks0.values()), f"uninstrumented run leaked: {leaks0}"
+
+    # -- contracts 1+2+3 under >= 50 fuzzed schedules -----------------------
+    for seed in range(N_SCHEDULES):
+        out, report, leaks = run_round(seed, instrumented=True)
+        assert np.array_equal(out, ref), \
+            f"seed {seed}: instrumented results differ from the direct " \
+            f"engine (instrumentation must observe, never perturb)"
+        assert report["total"] == 0, \
+            f"seed {seed} REPRODUCES {report['total']} race(s): " \
+            f"{report['races'][:2]}"
+        assert not any(leaks.values()), \
+            f"seed {seed}: runtime leak after drain: {leaks}"
+
+    # -- contract 4: clean uninstall ----------------------------------------
+    from concurrent.futures import Future as _RealFuture
+    post_snap = _snapshot_patchables(modules)
+    assert post_snap == pre_snap, \
+        "uninstall left patched module globals behind"
+    req_factory = mod_batcher.Request.__dataclass_fields__[
+        "future"].default_factory
+    assert req_factory is _RealFuture, \
+        "uninstall left a traced default_factory on Request.future"
+    for cell in mod_batcher.Request.__init__.__closure__ or ():
+        v = cell.cell_contents
+        assert not (isinstance(v, type) and issubclass(v, _RealFuture)
+                    and v is not _RealFuture), \
+            "uninstall left a traced factory in Request.__init__'s closure"
+    for cls in tracked:
+        for hook in ("__setattr__", "__getattribute__"):
+            fn = vars(cls).get(hook)
+            assert fn is None or \
+                "_patch_class" not in getattr(fn, "__qualname__", ""), \
+                f"uninstall left {hook} hook on {cls.__name__}"
+
+    print(f"race smoke OK: {N_SCHEDULES} fuzzed schedules x "
+          f"{len(SIZES)} requests with mid-burst replica kill — 0 races, "
+          f"0 leaks (spans/pins/futures), bitwise == direct engine, "
+          f"clean uninstall")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"race smoke FAILED: {e}", file=sys.stderr)
+        sys.exit(1)

@@ -409,16 +409,37 @@ BAD_UNLOCKED_STATE = """
 BAD_SWALLOW = """
     class Conn:
         def write(self, data):
+            span = self.tracer.start_span("write")
             try:
                 self.sock.sendall(data)
             except OSError:
-                self.dead = True          # drops the error silently
+                self.dead = True          # drops the error while a span is
+                                          # live: NOT the exempt teardown
+                                          # shape (acquisitions_in > 0)
+            span.finish()
 
         def tick(self):
             try:
                 self.poll()
             except Exception:
                 pass                      # the classic except-and-drop
+"""
+
+EXEMPT_TEARDOWN_SWALLOW = """
+    class Conn:
+        def close(self):
+            try:
+                self.sock.shutdown(2)
+            except OSError:
+                pass                      # teardown drop, acquisition-free:
+                                          # the leak pass retires the waiver
+            self.sock.close()
+
+        def write(self, data):
+            try:
+                self.sock.sendall(data)
+            except OSError:
+                self.dead = True          # constant-flag body, same verdict
 """
 
 CLEAN_SWALLOW = """
@@ -453,12 +474,11 @@ CLEAN_SWALLOW = """
 
 SUPPRESSED_SWALLOW = """
     class Conn:
-        def close(self):
+        def tick(self):
             try:
-                self.sock.shutdown(2)
-            except OSError:  # iwaelint: disable=swallowed-exception -- best-effort teardown of a possibly dead socket
+                self.poll()
+            except Exception:  # iwaelint: disable=swallowed-exception -- best-effort poll: the caller's next tick retries, and there is no future/span to complete
                 pass
-            self.sock.close()
 """
 
 
@@ -512,6 +532,12 @@ class TestConcurrencyRules:
         # a deliberate best-effort drop carries its justification in place
         # (and the suppression is LIVE, so useless-suppression stays quiet)
         assert self.lint(tmp_path, SUPPRESSED_SWALLOW) == []
+
+    def test_swallowed_exception_teardown_exemption(self, tmp_path):
+        # except-OSError teardown drops (pass / constant-flag bodies) in
+        # functions the leak pass proves acquisition-free need NO waiver —
+        # the PR-10 suppression-retirement semantics
+        assert self.lint(tmp_path, EXEMPT_TEARDOWN_SWALLOW) == []
 
     def test_outside_concurrency_paths_is_silent(self, tmp_path):
         assert self.lint(tmp_path, BAD_LOCK_ORDER, rel="other/m.py") == []

@@ -1,0 +1,1068 @@
+"""Tests for the iwae-race package (analysis/race/): the lockset +
+happens-before detector, the deterministic schedule fuzzers, the
+instrumented-sync layer's install/uninstall contract, the static
+thread-escape and future/span/pin leak passes, and the CLI.
+
+Per ISSUE 17: every HB-edge mechanism gets a fixture PAIR (a racy variant
+the detector must catch with a reproducing seed, and a synchronized twin
+that must stay clean); same-seed cooperative runs serialize to
+byte-identical reports; and instrumentation-off is the byte-identical
+pre-instrumentation code path — pinned here by comparing a real
+``ServingEngine``'s bitwise outputs with the layer installed, uninstalled,
+and never-installed.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from iwae_replication_project_tpu.analysis import (
+    LintConfig,
+    lint_paths,
+    load_config,
+)
+from iwae_replication_project_tpu.analysis.race import (
+    CooperativeScheduler,
+    Instrumentation,
+    PerturbFuzzer,
+    RaceDetector,
+    SchedulerDeadlock,
+    VectorClock,
+)
+from iwae_replication_project_tpu.analysis.race import cli as race_cli
+from iwae_replication_project_tpu.analysis.race import escape
+from iwae_replication_project_tpu.analysis.race.escape import classify_class
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: the cooperative fixtures schedule each racy variant under these seeds;
+#: the pairs' conflicting accesses are adjacent in program order, so a
+#: handful of seeded interleavings reliably includes an exposing one
+SEEDS = (0, 1, 2, 3, 4)
+
+
+# ---------------------------------------------------------------------------
+# vector clocks
+# ---------------------------------------------------------------------------
+
+class TestVectorClock:
+    def test_tick_and_dominates(self):
+        vc = VectorClock()
+        assert vc.time_of(0) == 0
+        vc.tick(0)
+        vc.tick(0)
+        assert vc.time_of(0) == 2
+        assert vc.dominates(0, 2)
+        assert not vc.dominates(0, 3)
+        assert vc.dominates(1, 0)       # time 0 is vacuously seen
+
+    def test_join_is_componentwise_max(self):
+        a, b = VectorClock({0: 3, 1: 1}), VectorClock({1: 5, 2: 2})
+        a.join(b)
+        assert a.c == {0: 3, 1: 5, 2: 2}
+        assert b.c == {1: 5, 2: 2}      # join mutates only the receiver
+
+    def test_copy_is_independent(self):
+        a = VectorClock({0: 1})
+        b = a.copy()
+        b.tick(0)
+        assert a.time_of(0) == 1 and b.time_of(0) == 2
+
+
+# ---------------------------------------------------------------------------
+# detector core: each HB edge, exercised directly (two OS threads whose
+# REAL ordering is enforced by raw untraced events, so the only HB the
+# detector can see is what the fixture explicitly records)
+# ---------------------------------------------------------------------------
+
+def _sequenced_pair(det, first, second):
+    """Run `first` then `second` on two distinct live OS threads. Raw
+    events order the bodies without telling the detector anything; both
+    threads stay alive until both ran (no ident reuse aliasing tids)."""
+    e1, e2 = threading.Event(), threading.Event()
+    errs = []
+
+    def a():
+        try:
+            det.register_thread("A")
+            first()
+        except Exception as e:          # pragma: no cover - harness bug
+            errs.append(e)
+        finally:
+            e1.set()
+        e2.wait(10)
+
+    def b():
+        e1.wait(10)
+        try:
+            det.register_thread("B")
+            second()
+        except Exception as e:          # pragma: no cover - harness bug
+            errs.append(e)
+        finally:
+            e2.set()
+
+    ta = threading.Thread(target=a)
+    tb = threading.Thread(target=b)
+    ta.start()
+    tb.start()
+    ta.join(10)
+    tb.join(10)
+    assert not errs, errs
+    assert not ta.is_alive() and not tb.is_alive()
+
+
+class TestDetectorEdges:
+    def test_unordered_unlocked_writes_race(self):
+        det = RaceDetector(capture_stacks=False)
+        _sequenced_pair(det,
+                        lambda: det.access("v", write=True),
+                        lambda: det.access("v", write=True))
+        assert det.report()["total"] == 1
+
+    def test_write_read_races_but_read_read_does_not(self):
+        det = RaceDetector(capture_stacks=False)
+        _sequenced_pair(det,
+                        lambda: det.access("v", write=True),
+                        lambda: det.access("v", write=False))
+        assert det.report()["total"] == 1
+        det2 = RaceDetector(capture_stacks=False)
+        _sequenced_pair(det2,
+                        lambda: det2.access("v", write=False),
+                        lambda: det2.access("v", write=False))
+        assert det2.report()["total"] == 0
+
+    def test_common_lockset_suppresses(self):
+        det = RaceDetector(capture_stacks=False)
+
+        def locked_write():
+            det.lock_acquired("L")
+            det.access("v", write=True)
+            det.lock_released("L")
+
+        _sequenced_pair(det, locked_write, locked_write)
+        assert det.report()["total"] == 0
+
+    def test_distinct_locks_do_not_suppress(self):
+        # disjoint locksets AND no shared sync clock: still a race — the
+        # hybrid falls back to neither ingredient
+        det = RaceDetector(capture_stacks=False)
+
+        def under(name):
+            det.lock_acquired(name)
+            det.access("v", write=True)
+            det.lock_released(name)
+
+        _sequenced_pair(det, lambda: under("L1"), lambda: under("L2"))
+        assert det.report()["total"] == 1
+
+    def test_future_completion_edge(self):
+        det = RaceDetector(capture_stacks=False)
+
+        def produce():
+            det.access("v", write=True)
+            det.future_completed(7)
+
+        def consume():
+            det.future_observed(7)
+            det.access("v", write=True)
+
+        _sequenced_pair(det, produce, consume)
+        assert det.report()["total"] == 0
+
+    def test_callback_registration_edge(self):
+        # add_done_callback: registration publishes the registrant's
+        # history to the invocation (modeled as a completion of the same
+        # clock) — the edge that orders closure state handed to callbacks
+        det = RaceDetector(capture_stacks=False)
+
+        def register():
+            det.access("v", write=True)
+            det.future_registered(7)
+
+        def invoke():
+            det.future_observed(7)
+            det.access("v", write=True)
+
+        _sequenced_pair(det, register, invoke)
+        assert det.report()["total"] == 0
+
+    def test_queue_fifo_edge(self):
+        det = RaceDetector(capture_stacks=False)
+
+        def put():
+            det.access("v", write=True)
+            det.queue_put(1)
+
+        def get():
+            det.queue_got(1)
+            det.access("v", write=True)
+
+        _sequenced_pair(det, put, get)
+        assert det.report()["total"] == 0
+
+    def test_event_set_edge(self):
+        det = RaceDetector(capture_stacks=False)
+
+        def setter():
+            det.access("v", write=True)
+            det.event_set(3)
+
+        def waiter():
+            det.event_observed(3)
+            det.access("v", write=True)
+
+        _sequenced_pair(det, setter, waiter)
+        assert det.report()["total"] == 0
+
+    def test_lock_release_acquire_edge(self):
+        # TSan hb-mode: a critical section on L publishes everything its
+        # thread did BEFORE it (the bare write included) to the next
+        # acquirer of L — the serving stack's ownership-handoff idiom
+        det = RaceDetector(capture_stacks=False)
+
+        def handoff():
+            det.access("v", write=True)         # bare, pre-section
+            det.lock_acquired("L")
+            det.lock_released("L")
+
+        def successor():
+            det.lock_acquired("L")
+            det.lock_released("L")
+            det.access("v", write=True)         # bare, post-section
+
+        _sequenced_pair(det, handoff, successor)
+        assert det.report()["total"] == 0
+
+    def test_lock_edge_is_directional(self):
+        # the same two critical sections do NOT order an access that
+        # happens before the second thread's acquire — proof the clean
+        # verdict above comes from the sync clock, not from the lockset
+        det = RaceDetector(capture_stacks=False)
+
+        def handoff():
+            det.access("v", write=True)
+            det.lock_acquired("L")
+            det.lock_released("L")
+
+        def too_early():
+            det.access("v", write=True)         # before joining L's clock
+            det.lock_acquired("L")
+            det.lock_released("L")
+
+        _sequenced_pair(det, handoff, too_early)
+        assert det.report()["total"] == 1
+
+    def test_report_is_deduped_per_program_point(self):
+        det = RaceDetector(capture_stacks=False)
+
+        def writes():
+            for _ in range(5):
+                det.access("v", write=True)
+
+        _sequenced_pair(det, writes, writes)
+        # many dynamic conflicts, one (var, stacks) program-point pair
+        assert det.report()["total"] == 1
+
+
+# ---------------------------------------------------------------------------
+# cooperative fixtures: a racy/synchronized pair per mechanism, driven by
+# the seeded single-baton scheduler (every catch carries its repro seed)
+# ---------------------------------------------------------------------------
+
+def _cooperative(seed):
+    det = RaceDetector()
+    sched = CooperativeScheduler(seed)
+    ins = Instrumentation(detector=det, fuzz=sched)
+
+    class Box:
+        def __init__(self):
+            self.v = 0
+
+    box = ins.track(Box())
+    return det, sched, ins, box
+
+
+def _run_threads(sched, ins, *bodies):
+    def driver():
+        ts = [ins.thread(target=b, name=f"w{i}")
+              for i, b in enumerate(bodies)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    sched.run(driver)
+
+
+def _future_fixture(seed, ordered):
+    det, sched, ins, box = _cooperative(seed)
+    fut = ins.future()
+
+    def producer():
+        box.v = 1
+        fut.set_result(1)
+
+    def consumer():
+        if ordered:
+            fut.result()
+        n = box.v                       # noqa: F841 - the traced read
+
+    _run_threads(sched, ins, producer, consumer)
+    return det.report()
+
+
+def _queue_fixture(seed, ordered):
+    det, sched, ins, box = _cooperative(seed)
+    q = ins.make_queue()
+
+    def producer():
+        box.v = 1
+        q.put("item")
+
+    def consumer():
+        if ordered:
+            q.get()
+        n = box.v                       # noqa: F841
+
+    _run_threads(sched, ins, producer, consumer)
+    return det.report()
+
+
+def _event_fixture(seed, ordered):
+    det, sched, ins, box = _cooperative(seed)
+    evt = ins.event()
+
+    def setter():
+        box.v = 1
+        evt.set()
+
+    def waiter():
+        if ordered:
+            evt.wait()
+        n = box.v                       # noqa: F841
+
+    _run_threads(sched, ins, setter, waiter)
+    return det.report()
+
+
+def _join_fixture(seed, ordered):
+    det, sched, ins, box = _cooperative(seed)
+
+    def bump():
+        box.v = box.v + 1
+
+    def driver():
+        t1 = ins.thread(target=bump, name="w1")
+        t2 = ins.thread(target=bump, name="w2")
+        if ordered:
+            t1.start()
+            t1.join()                   # join edge orders the pair
+            t2.start()
+            t2.join()
+        else:
+            t1.start()
+            t2.start()
+            t1.join()
+            t2.join()
+
+    sched.run(driver)
+    return det.report()
+
+
+def _callback_fixture(seed, ordered):
+    det, sched, ins, box = _cooperative(seed)
+    fut = ins.future()
+
+    if ordered:
+        # registrant writes, then registers a callback reading the same
+        # state; a second thread completes the future — the registration
+        # edge orders write -> callback regardless of completer thread
+        def driver():
+            box.v = 1
+            fut.add_done_callback(lambda f: box.v)
+            t = ins.thread(target=lambda: fut.set_result(1), name="comp")
+            t.start()
+            t.join()
+    else:
+        # two futures completed by two threads, both callbacks write the
+        # same attr: the callbacks run on unordered completer threads
+        fut2 = ins.future()
+
+        def bump(f):
+            f()
+            box.v = box.v + 1
+
+        fut.add_done_callback(lambda f: bump(lambda: None))
+        fut2.add_done_callback(lambda f: bump(lambda: None))
+
+        def driver():
+            t1 = ins.thread(target=lambda: fut.set_result(1), name="c1")
+            t2 = ins.thread(target=lambda: fut2.set_result(1), name="c2")
+            t1.start()
+            t2.start()
+            t1.join()
+            t2.join()
+
+    sched.run(driver)
+    return det.report()
+
+
+_PAIRS = {
+    "future": _future_fixture,
+    "queue": _queue_fixture,
+    "event": _event_fixture,
+    "start_join": _join_fixture,
+    "callback": _callback_fixture,
+}
+
+
+class TestCooperativePairs:
+    @pytest.mark.parametrize("mechanism", sorted(_PAIRS))
+    def test_racy_variant_is_caught_with_a_repro_seed(self, mechanism):
+        fixture = _PAIRS[mechanism]
+        caught = [s for s in SEEDS if fixture(s, ordered=False)["total"] > 0]
+        assert caught, f"{mechanism}: no seed exposed the racy twin"
+        # the report names its schedule: re-running the seed reproduces
+        report = fixture(caught[0], ordered=False)
+        assert report["seed"] == caught[0] and report["total"] > 0
+
+    @pytest.mark.parametrize("mechanism", sorted(_PAIRS))
+    def test_synchronized_twin_is_clean_under_every_seed(self, mechanism):
+        fixture = _PAIRS[mechanism]
+        for seed in SEEDS:
+            report = fixture(seed, ordered=True)
+            assert report["total"] == 0, \
+                f"{mechanism}: false positive under seed {seed}: " \
+                f"{report['races']}"
+
+    @pytest.mark.parametrize("mechanism", sorted(_PAIRS))
+    def test_same_seed_reports_are_byte_identical(self, mechanism):
+        fixture = _PAIRS[mechanism]
+        for seed in SEEDS[:2]:
+            a = json.dumps(fixture(seed, ordered=False), sort_keys=True)
+            b = json.dumps(fixture(seed, ordered=False), sort_keys=True)
+            assert a == b
+
+    def test_locked_counter_is_clean(self):
+        # the lockset half of the hybrid, through the full traced stack
+        for seed in SEEDS:
+            det, sched, ins, box = _cooperative(seed)
+            lock = ins.lock()
+
+            def bump():
+                with lock:
+                    box.v = box.v + 1
+
+            _run_threads(sched, ins, bump, bump)
+            assert det.report()["total"] == 0
+
+    def test_racy_report_carries_stacks_and_thread_names(self):
+        caught = next(s for s in SEEDS
+                      if _join_fixture(s, ordered=False)["total"] > 0)
+        report = _join_fixture(caught, ordered=False)
+        race = report["races"][0]
+        assert race["var"].startswith("Box#")
+        for side in (race["first"], race["second"]):
+            assert side["thread_name"] in ("w1", "w2")
+            assert side["stack"], "access stacks must be captured"
+
+    def test_self_test_battery_is_green(self):
+        verdicts = race_cli.run_self_test()
+        assert verdicts["ok"], verdicts
+        assert verdicts["racy_caught_seeds"]
+
+
+class TestSchedulers:
+    def test_deadlock_is_a_verdict_not_a_hang(self):
+        det = RaceDetector(capture_stacks=False)
+        sched = CooperativeScheduler(0)
+        sched.bind(det)
+        t0 = time.monotonic()
+        with pytest.raises(SchedulerDeadlock):
+            sched.run(lambda: sched.block_until(lambda: False))
+        assert time.monotonic() - t0 < 4 * CooperativeScheduler.DEADLOCK_GRACE_S
+
+    def test_perturb_decision_schedule_is_seed_deterministic(self,
+                                                             monkeypatch):
+        def decisions(seed):
+            det = RaceDetector(capture_stacks=False)
+            fuzz = PerturbFuzzer(seed, rate=0.5, max_sleep_s=0.001)
+            fuzz.bind(det)
+            rec = []
+            monkeypatch.setattr(time, "sleep", rec.append)
+            try:
+                for _ in range(200):
+                    fuzz.on_op("x")
+            finally:
+                monkeypatch.undo()
+            return rec
+
+        assert decisions(3) == decisions(3)
+        assert decisions(3) != decisions(4)
+
+    def test_fuzzer_stamps_its_seed_into_the_report(self):
+        det = RaceDetector(capture_stacks=False)
+        PerturbFuzzer(17).bind(det)
+        assert det.report()["seed"] == 17
+
+
+# ---------------------------------------------------------------------------
+# the instrumented-sync layer: install/uninstall restore contract
+# ---------------------------------------------------------------------------
+
+def _fake_module(name="fakemod"):
+    import types
+    mod = types.ModuleType(name)
+    src = textwrap.dedent("""
+        import queue
+        import threading
+        from concurrent.futures import Future
+        from dataclasses import dataclass, field
+
+        @dataclass
+        class Req:
+            future: Future = field(default_factory=Future)
+
+        def make_lock():
+            return threading.Lock()
+
+        def make_queue():
+            return queue.Queue()
+    """)
+    exec(compile(src, f"{name}.py", "exec"), mod.__dict__)
+    return mod
+
+
+class TestInstrumentationInstall:
+    def test_module_globals_swap_and_exact_restore(self):
+        import queue as real_queue
+        import threading as real_threading
+        from concurrent.futures import Future as RealFuture
+
+        mod = _fake_module()
+        ins = Instrumentation(RaceDetector(capture_stacks=False))
+        ins.install(modules=(mod,))
+        assert mod.threading is ins.threading
+        assert mod.queue is ins.queue
+        assert mod.Future is ins.future_cls
+        assert type(mod.make_lock()).__name__ == "_TracedLock"
+        assert type(mod.make_queue()).__name__ == "TracedQueue"
+        ins.uninstall()
+        assert mod.threading is real_threading
+        assert mod.queue is real_queue
+        assert mod.Future is RealFuture
+        assert type(mod.make_lock()) is type(real_threading.Lock())
+
+    def test_dataclass_default_factory_swap_reaches_the_closure(self):
+        # field(default_factory=Future) bakes the REAL class into the
+        # generated __init__'s closure at class-definition time; the
+        # install must patch Field metadata AND the closure cell, and the
+        # uninstall must put the real class back in both places
+        from concurrent.futures import Future as RealFuture
+
+        mod = _fake_module()
+        ins = Instrumentation(RaceDetector(capture_stacks=False))
+        ins.install(modules=(mod,))
+        assert type(mod.Req().future) is ins.future_cls
+        ins.uninstall()
+        assert type(mod.Req().future) is RealFuture
+        assert mod.Req.__dataclass_fields__["future"].default_factory \
+            is RealFuture
+        for cell in mod.Req.__init__.__closure__ or ():
+            v = cell.cell_contents
+            assert not (isinstance(v, type) and issubclass(v, RealFuture)
+                        and v is not RealFuture)
+
+    def test_class_hooks_install_and_vanish_on_uninstall(self):
+        class Plain:
+            pass
+
+        ins = Instrumentation(RaceDetector(capture_stacks=False))
+        ins.track(Plain())
+        assert "__setattr__" in vars(Plain)
+        assert "__getattribute__" in vars(Plain)
+        ins.uninstall()
+        assert "__setattr__" not in vars(Plain)
+        assert "__getattribute__" not in vars(Plain)
+
+    def test_sync_valued_and_private_attrs_are_not_data(self):
+        # reading the lock handle off an object IS synchronization; tracing
+        # it would flag every guarded class on its own lock attribute
+        det = RaceDetector(capture_stacks=False)
+        ins = Instrumentation(det)
+
+        class Holder:
+            pass
+
+        h = ins.track(Holder())
+        try:
+            h.lock = threading.Lock()
+            h._race_scratch = 1
+            h.n = 1
+        finally:
+            ins.uninstall()
+        assert "Holder#0.n" in det._vars
+        assert not any(v.endswith(".lock") for v in det._vars)
+        assert not any("_race_" in v for v in det._vars)
+
+    def test_active_context_manager_uninstalls_on_error(self):
+        import threading as real_threading
+
+        mod = _fake_module()
+        ins = Instrumentation(RaceDetector(capture_stacks=False))
+        with pytest.raises(RuntimeError):
+            with ins.active(modules=(mod,)):
+                assert mod.threading is ins.threading
+                raise RuntimeError("boom")
+        assert mod.threading is real_threading
+
+
+# ---------------------------------------------------------------------------
+# real-engine parity: instrumentation observes, never perturbs, and off is
+# the byte-identical pre-instrumentation code path
+# ---------------------------------------------------------------------------
+
+class TestEngineParity:
+    def test_instrumented_engine_is_bitwise_identical_and_race_clean(self):
+        from concurrent.futures import Future as RealFuture
+
+        import jax
+        import numpy as np
+
+        from iwae_replication_project_tpu.models import iwae as model
+        from iwae_replication_project_tpu.serving import ServingEngine
+        from iwae_replication_project_tpu.serving import batcher as mod_batcher
+        from iwae_replication_project_tpu.serving import engine as mod_engine
+
+        D = 32
+        cfg = model.ModelConfig(x_dim=D, n_hidden_enc=(16, 8),
+                                n_latent_enc=(8, 4), n_hidden_dec=(8, 16),
+                                n_latent_dec=(8, D))
+        params = model.init_params(jax.random.PRNGKey(0), cfg)
+        x = (np.random.RandomState(0).rand(6, D) > 0.5).astype(np.float32)
+
+        def run(instrumented, seed=0):
+            ins = None
+            if instrumented:
+                det = RaceDetector(stack_depth=4)
+                ins = Instrumentation(det,
+                                      PerturbFuzzer(seed, rate=0.25,
+                                                    max_sleep_s=0.001))
+                ins.install(
+                    modules=(mod_engine, mod_batcher),
+                    classes=(ServingEngine, mod_batcher.MicroBatcher,
+                             mod_batcher.InflightWindow))
+            try:
+                eng = ServingEngine(params=params, model_config=cfg, k=4,
+                                    max_batch=8, timeout_s=30.0)
+                eng.warmup(ops=("score",))
+                out = eng.score(x)
+                eng.stop()
+            finally:
+                if ins is not None:
+                    ins.uninstall()
+            return out, (ins.det.report() if ins else None)
+
+        ref, _ = run(instrumented=False)
+        on, report = run(instrumented=True)
+        assert report["total"] == 0, report["races"][:2]
+        assert np.array_equal(on, ref), \
+            "instrumentation must observe, never perturb results"
+        off, _ = run(instrumented=False)
+        assert np.array_equal(off, ref), \
+            "post-uninstall engine differs from the pre-install one"
+        # the factory the uninstalled Request constructor calls is the
+        # real Future again (Field metadata AND the __init__ closure)
+        assert mod_batcher.Request.__dataclass_fields__[
+            "future"].default_factory is RealFuture
+        assert type(mod_batcher.Request(
+            op="score", payload=None, k=1, seed=0, t_enqueue=0.0,
+            deadline=None).future) is RealFuture
+
+
+# ---------------------------------------------------------------------------
+# static thread-escape analysis
+# ---------------------------------------------------------------------------
+
+def _classify(src, skip=()):
+    tree = ast.parse(textwrap.dedent(src))
+    cls = next(n for n in tree.body if isinstance(n, ast.ClassDef))
+    return classify_class(cls, skip_attrs=set(skip))
+
+
+class TestEscapeAnalysis:
+    #: appended to CONFINED at the class-body indent level (before dedent)
+    READ_N = ("\n            def read(self):\n"
+              "                return self.n\n")
+
+    CONFINED = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.n = 0
+
+            def start(self):
+                self.t = threading.Thread(target=self._loop)
+                self.t.start()
+
+            def _loop(self):
+                self.n = self.n + 1
+    """
+
+    def test_single_thread_root_attr_is_confined(self):
+        esc = _classify(self.CONFINED)
+        assert esc.roots_of("n") == {"thread:_loop"}
+        assert esc.confined("n")
+        assert not esc.escaping("n")
+
+    def test_external_reader_makes_it_escape(self):
+        esc = _classify(self.CONFINED + self.READ_N)
+        assert esc.roots_of("n") == {"thread:_loop", escape.EXTERNAL}
+        assert esc.escaping("n") and not esc.confined("n")
+
+    def test_reachability_follows_same_class_calls(self):
+        esc = _classify("""
+            import threading
+
+            class W:
+                def start(self):
+                    threading.Thread(target=self._loop).start()
+
+                def _loop(self):
+                    self._step()
+
+                def _step(self):
+                    self.n = 1
+        """)
+        # _step's access lands in the thread root via the _loop -> _step
+        # call edge; _step itself also counts as an external entry (the
+        # analysis assumes any non-target method is publicly callable)
+        assert "thread:_loop" in esc.roots_of("n")
+
+    def test_done_callback_is_a_thread_root(self):
+        esc = _classify("""
+            class W:
+                def arm(self, fut):
+                    fut.add_done_callback(self._on_done)
+
+                def _on_done(self, f):
+                    self.done = True
+
+                def poll(self):
+                    return self.done
+        """)
+        assert esc.roots_of("done") == {"thread:_on_done", escape.EXTERNAL}
+        assert esc.escaping("done")
+
+    def test_queue_put_payload_is_a_handoff(self):
+        esc = _classify("""
+            class W:
+                def push(self, q):
+                    q.put(self.buf)
+        """)
+        assert escape.HANDOFF in esc.roots_of("buf")
+        assert esc.escaping("buf")
+
+    def test_thread_args_payload_is_a_handoff(self):
+        esc = _classify("""
+            import threading
+
+            class W:
+                def start(self):
+                    threading.Thread(target=self._loop,
+                                     args=(self.shared,)).start()
+
+                def _loop(self, shared):
+                    pass
+        """)
+        assert escape.HANDOFF in esc.roots_of("shared")
+
+    def test_skip_attrs_hide_lock_attributes(self):
+        esc = _classify(self.CONFINED + self.READ_N, skip=("n",))
+        assert esc.roots_of("n") == {escape.EXTERNAL}   # the default
+
+    def test_external_only_attr_neither_confined_nor_escaping(self):
+        esc = _classify("""
+            class W:
+                def set(self, v):
+                    self.v = v
+
+                def get(self):
+                    return self.v
+        """)
+        assert esc.roots_of("v") == {escape.EXTERNAL}
+        assert not esc.confined("v") and not esc.escaping("v")
+
+
+# ---------------------------------------------------------------------------
+# the upgraded unlocked-shared-state rule (escape-aware) and the static
+# leak pass, through the lint framework
+# ---------------------------------------------------------------------------
+
+def _lint(tmp_path, src, rel, **config_over):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    cfg = LintConfig(root=str(tmp_path), **config_over)
+    return lint_paths([str(path)], cfg, root=str(tmp_path))
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+class TestEscapeAwareLint:
+    ESCAPING = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.n = 0
+
+            def start(self):
+                self.t = threading.Thread(target=self._loop)
+                self.t.start()
+
+            def _loop(self):
+                self.n = self.n + 1
+
+            def read(self):
+                return self.n
+    """
+
+    def lint(self, tmp_path, src):
+        return _lint(tmp_path, src, rel="conc/m.py",
+                     concurrency_paths=["conc"])
+
+    def test_never_guarded_escaping_write_fires(self, tmp_path):
+        got = self.lint(tmp_path, self.ESCAPING)
+        assert "unlocked-shared-state" in _rules(got)
+        assert "escapes to multiple thread roots" in got[0].message
+
+    def test_thread_confined_write_is_clean(self, tmp_path):
+        confined = self.ESCAPING.replace(
+            "            def read(self):\n"
+            "                return self.n\n", "")
+        assert self.lint(tmp_path, confined) == []
+
+
+BAD_SPAN = """
+    def handle(tracer, risky):
+        span = tracer.start_span("req")
+        risky()
+        span.finish()
+"""
+
+GOOD_SPAN_FINALLY = """
+    def handle(tracer, risky):
+        span = tracer.start_span("req")
+        try:
+            risky()
+        finally:
+            span.finish()
+"""
+
+GOOD_SPAN_STRAIGHT_LINE = """
+    def handle(tracer):
+        span = tracer.start_span("req")
+        ok = True
+        span.finish()
+        return ok
+"""
+
+NEVER_SUNK_SPAN = """
+    def handle(tracer):
+        span = tracer.start_span("req")
+        return None
+"""
+
+DROPPED_FUTURE = """
+    from concurrent.futures import Future
+
+    def submit():
+        Future()
+"""
+
+BAD_FUTURE = """
+    from concurrent.futures import Future
+
+    def submit(work):
+        f = Future()
+        work.validate()
+        f.set_result(1)
+        return f
+"""
+
+GOOD_FUTURE_EXCEPT_ALL = """
+    from concurrent.futures import Future
+
+    def submit(work):
+        f = Future()
+        try:
+            work.run()
+        except Exception as e:
+            f.set_exception(e)
+            raise
+        f.set_result(1)
+        return f
+"""
+
+GOOD_FUTURE_STORED_AT_BIRTH = """
+    from concurrent.futures import Future
+
+    def submit(self, key):
+        self.pending[key] = Future()
+"""
+
+BAD_PIN = """
+    def score(store, sig, xs):
+        pin = store.pin_prefix(sig)
+        out = xs.sum()
+        pin.release()
+        return out
+"""
+
+GOOD_PIN = """
+    def score(store, sig, xs):
+        pin = store.pin_prefix(sig)
+        try:
+            return run(pin, xs)
+        finally:
+            pin.release()
+"""
+
+SUPPRESSED_SPAN = """
+    def handle(tracer, risky):
+        span = tracer.start_span("req")  # iwaelint: disable=leaked-span -- risky() is exception-free by construction (pure dict lookup); the straight-line finish below always runs
+        risky()
+        span.finish()
+"""
+
+
+class TestLeakPass:
+    def lint(self, tmp_path, src):
+        return _lint(tmp_path, src, rel="leak/m.py", leak_paths=["leak"],
+                     select=["leaked-future", "leaked-span", "leaked-pin"])
+
+    def test_span_leaks_when_a_call_can_raise_before_finish(self, tmp_path):
+        got = self.lint(tmp_path, BAD_SPAN)
+        assert _rules(got) == ["leaked-span"]
+        assert "leaks if line" in got[0].message
+
+    def test_span_protected_by_finally_is_clean(self, tmp_path):
+        assert self.lint(tmp_path, GOOD_SPAN_FINALLY) == []
+
+    def test_span_with_nothing_raising_before_finish_is_clean(self,
+                                                              tmp_path):
+        assert self.lint(tmp_path, GOOD_SPAN_STRAIGHT_LINE) == []
+
+    def test_span_with_no_sink_at_all_fires(self, tmp_path):
+        got = self.lint(tmp_path, NEVER_SUNK_SPAN)
+        assert _rules(got) == ["leaked-span"]
+        assert "never completed" in got[0].message
+
+    def test_unbound_future_fires(self, tmp_path):
+        got = self.lint(tmp_path, DROPPED_FUTURE)
+        assert _rules(got) == ["leaked-future"]
+        assert "never bound" in got[0].message
+
+    def test_future_leaks_across_a_raising_call(self, tmp_path):
+        assert _rules(self.lint(tmp_path, BAD_FUTURE)) == ["leaked-future"]
+
+    def test_future_with_except_all_completion_is_clean(self, tmp_path):
+        assert self.lint(tmp_path, GOOD_FUTURE_EXCEPT_ALL) == []
+
+    def test_future_stored_at_birth_is_a_handoff(self, tmp_path):
+        assert self.lint(tmp_path, GOOD_FUTURE_STORED_AT_BIRTH) == []
+
+    def test_pin_pair(self, tmp_path):
+        assert _rules(self.lint(tmp_path, BAD_PIN)) == ["leaked-pin"]
+        assert self.lint(tmp_path, GOOD_PIN) == []
+
+    def test_justified_suppression_silences_a_leak_finding(self, tmp_path):
+        assert self.lint(tmp_path, SUPPRESSED_SPAN) == []
+
+    def test_future_with_ctor_args_is_not_an_acquisition(self, tmp_path):
+        # Future(x) is some other library's constructor, not the stdlib
+        # zero-arg acquisition this pass owns
+        src = """
+            def submit(x):
+                f = Future(x)
+                work()
+        """
+        assert self.lint(tmp_path, src) == []
+
+    def test_shipped_leak_paths_are_clean(self):
+        # the CI invocation: the configured serving control plane passes
+        cfg, _ = load_config(REPO)
+        cfg.select = ["leaked-future", "leaked-span", "leaked-pin"]
+        assert lint_paths(cfg.leak_paths, cfg, root=REPO) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI exit contract
+# ---------------------------------------------------------------------------
+
+class TestRaceCli:
+    def _run(self, *args, cwd=REPO):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        return subprocess.run(
+            [sys.executable, "-m",
+             "iwae_replication_project_tpu.analysis.race", *args],
+            cwd=cwd, env=env, capture_output=True, text=True)
+
+    def _leak_tree(self, tmp_path, src):
+        # --no-config uses the built-in leak_paths; mirror one of them
+        # under a scratch root so the rules are in scope for the file
+        rel = "iwae_replication_project_tpu/serving/engine.py"
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+        return rel
+
+    def test_clean_file_exits_0(self, tmp_path):
+        rel = self._leak_tree(tmp_path, "x = 1\n")
+        r = self._run("--no-config", rel, cwd=tmp_path)
+        assert r.returncode == 0, r.stderr
+        assert "leak pass clean" in r.stdout
+
+    def test_findings_exit_1_with_json(self, tmp_path):
+        rel = self._leak_tree(tmp_path, BAD_SPAN)
+        r = self._run("--no-config", "--format", "json", rel, cwd=tmp_path)
+        assert r.returncode == 1, r.stderr
+        payload = json.loads(r.stdout)
+        assert payload["counts"] == {"leaked-span": 1}
+
+    def test_missing_path_exits_2(self, tmp_path):
+        r = self._run("--no-config", "does_not_exist.py", cwd=tmp_path)
+        assert r.returncode == 2
+        assert "error" in r.stderr
+
+    def test_list_rules_exits_0(self):
+        r = self._run("--list-rules")
+        assert r.returncode == 0
+        for rule in ("leaked-future", "leaked-span", "leaked-pin"):
+            assert rule in r.stdout
+
+    def test_self_test_reports_verdicts_in_json(self, tmp_path):
+        rel = self._leak_tree(tmp_path, "x = 1\n")
+        r = self._run("--no-config", "--self-test", "--format", "json",
+                      rel, cwd=tmp_path)
+        assert r.returncode == 0, r.stderr
+        st = json.loads(r.stdout)["self_test"]
+        assert st["ok"] and st["racy_caught_seeds"]
+
+    def test_shipped_tree_is_clean_via_configured_paths(self):
+        # the exact CI stage: pyproject leak_paths, exit 0
+        r = self._run()
+        assert r.returncode == 0, r.stdout + r.stderr
