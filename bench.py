@@ -2440,6 +2440,236 @@ def bench_precision():
         pass
 
 
+AUTOSCALE_SIZES = (1, 2, 3)   # static fleet sizes on the curve
+AUTOSCALE_ROWS = 96           # pipelined single-row requests per leg
+AUTOSCALE_WINDOW = 8          # client in-flight window (the offered load)
+AUTOSCALE_K = 256             # per-request k: compute-bound, ms-scale service
+AUTOSCALE_CAL_REPS = 15       # warm single-row reps for the objective calibration
+
+
+def bench_autoscale():
+    """``--autoscale``: SLO-vs-fleet-size curves for the elastic fleet
+    (serving/fleet/ — ISSUE 18).
+
+    The same fixed stream — AUTOSCALE_ROWS single-row ``score`` requests
+    pipelined through one connection with an AUTOSCALE_WINDOW in-flight
+    window — is offered to:
+
+    * **static fleets of 1..3 replicas** (compute-bound k=AUTOSCALE_K
+      engines over SHARED params, max_batch=1 so batching never launders
+      queue wait), each with its own SLOMonitor under a host-calibrated
+      latency objective (2x the warm single-row p50, so the number means
+      the same on any machine): throughput + whole-leg latency burn per
+      size is the curve the autoscaler's thresholds sit on;
+    * **an elastic fleet** starting at 1 replica with the FleetManager
+      control thread live (short burn windows, min=1 max=3): the same
+      stream, plus the decision log, the replica trajectory, and the
+      post-idle shrink back to min.
+
+    Results are a pure function of (weights, payload, seed, k) and seeds
+    are minted at admission, so every leg — static or elastic — must
+    return bitwise-identical values; the bench asserts it. Burn windows
+    for the static legs are longer than any leg's wall time, so their
+    burn is the whole-leg violation fraction over the error budget, not a
+    trailing sample.
+
+    In-process replicas share the host's XLA CPU thread pool: on a host
+    with fewer cores than max(AUTOSCALE_SIZES) the fleet CANNOT scale
+    compute, so the burn curve is honestly flat and ``host.note`` says so
+    (the precision bench's CPU-host pattern) — a multi-core/TPU bench
+    round resolves the slope. Prints one JSON line and writes
+    results/autoscale_bench.json.
+    """
+    import jax
+
+    from iwae_replication_project_tpu.models import iwae as tiny_model
+    from iwae_replication_project_tpu.serving import ServingEngine
+    from iwae_replication_project_tpu.serving.fleet import (
+        AutoscaleConfig, FleetManager)
+    from iwae_replication_project_tpu.serving.frontend import (
+        ServingTier, TierClient)
+    from iwae_replication_project_tpu.telemetry.slo import (
+        SLOMonitor, SLOObjective, peak_burns, window_requests)
+
+    D = 128
+    mcfg = tiny_model.ModelConfig(x_dim=D, n_hidden_enc=(64, 32),
+                                  n_latent_enc=(16, 8),
+                                  n_hidden_dec=(32, 64),
+                                  n_latent_dec=(16, D))
+    params = tiny_model.init_params(jax.random.PRNGKey(0), mcfg)
+
+    def engine():
+        return ServingEngine(params=params, model_config=mcfg,
+                             k=AUTOSCALE_K, max_batch=1, max_inflight=2,
+                             timeout_s=30.0)
+
+    n = AUTOSCALE_ROWS
+    rows = (np.random.RandomState(0).rand(n, D) > 0.5).astype(np.float32)
+
+    # calibrate the objective on THIS host: the unloaded warm single-row
+    # p50, doubled. Under the pipelined window the queue wait dominates
+    # that threshold on a 1-replica fleet and fades as replicas join —
+    # which is exactly the shape a fleet-size curve must resolve.
+    cal = engine()
+    cal.warmup(ops=("score",))
+    lat = []
+    for _ in range(AUTOSCALE_CAL_REPS):
+        t0 = time.perf_counter()
+        cal.score(rows[0])
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    obj_s = 2.0 * lat[len(lat) // 2]
+    objective = SLOObjective(latency_s=obj_s)
+
+    def run_stream(port):
+        """Windowed closed loop on one connection (admission order ==
+        submit order, so seeds — and results — line up across legs)."""
+        vals = []
+        with TierClient("127.0.0.1", port, timeout_s=60.0) as cli:
+            pending = []
+            nxt = 0
+            t0 = time.perf_counter()
+            while len(vals) < n:
+                while nxt < n and len(pending) < AUTOSCALE_WINDOW:
+                    pending.append(
+                        cli.submit("score", [rows[nxt].tolist()]))
+                    nxt += 1
+                # wait() raises TierError on any non-ok response — a lost
+                # or shed request fails the bench loudly
+                vals.append(cli.wait(pending.pop(0))[0])
+            wall = time.perf_counter() - t0
+        return vals, wall
+
+    # -- static legs: one point per fleet size ------------------------------
+    curve = []
+    ref = None
+    for size in AUTOSCALE_SIZES:
+        # windows longer than the leg: burn == whole-leg violation fraction
+        slo = SLOMonitor(default=objective,
+                         windows=((120.0, "5m"), (240.0, "1h")))
+        tier = ServingTier([engine() for _ in range(size)], slo=slo,
+                           monitor_interval_s=0.05)
+        tier.warmup(ops=("score",))
+        tier.start()
+        try:
+            vals, wall = run_stream(tier.port)
+            snap = slo.snapshot()
+        finally:
+            tier.stop(timeout_s=30)
+        if ref is None:
+            ref = vals
+        assert vals == ref, \
+            f"fleet size {size} changed results — seeds must not move"
+        burns = peak_burns(snap)
+        curve.append({
+            "replicas": size,
+            "requests": n,
+            "wall_seconds": round(wall, 3),
+            "rows_per_sec": round(n / wall, 2),
+            "latency_burn": round(burns.get("5m", 0.0), 3),
+            "violation_fraction": round(
+                burns.get("5m", 0.0) * (1.0 - objective.latency_target), 4),
+        })
+
+    # -- elastic leg: same stream, autoscaler live --------------------------
+    # short burn windows so idle actually rotates clean and the post-load
+    # shrink is observable within the bench's budget
+    fast_s, slow_s = 2.0, 4.0
+    slo = SLOMonitor(default=objective,
+                     windows=((fast_s, "5m"), (slow_s, "1h")))
+    tier = ServingTier([engine()], slo=slo, monitor_interval_s=0.05)
+    tier.warmup(ops=("score",))
+    tier.start()
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=max(AUTOSCALE_SIZES),
+                          scale_up_burn=1.0, scale_down_burn=0.25,
+                          up_cooldown_s=0.3, down_cooldown_s=0.5,
+                          interval_s=0.05, seed=0)
+    mgr = FleetManager(tier, engine, cfg, warmup_ops=("score",),
+                       drain_timeout_s=20.0)
+    mgr.start()
+    try:
+        vals, wall = run_stream(tier.port)
+        assert vals == ref, \
+            "elastic fleet changed results — seeds must not move"
+        peak_replicas = max((max(r["replicas"], r["target"])
+                             for r in mgr.decision_log), default=1)
+        # idle: wait for the shrink back to min (burn rotates clean in
+        # fast_s; then the down-cooldown must lapse per drop)
+        deadline = time.monotonic() + fast_s + 30.0
+        while time.monotonic() < deadline:
+            live = [s for s in tier.router.replica_states()
+                    if s["healthy"] and not s["draining"]]
+            if len(live) == cfg.min_replicas:
+                break
+            time.sleep(0.05)
+        final_replicas = len([s for s in tier.router.replica_states()
+                              if s["healthy"] and not s["draining"]])
+    finally:
+        mgr.stop()
+        tier.stop(timeout_s=30)
+    actions = [r["action"] for r in mgr.decision_log if r["action"] != "hold"]
+    elastic = {
+        "requests": n,
+        "wall_seconds": round(wall, 3),
+        "rows_per_sec": round(n / wall, 2),
+        "start_replicas": 1,
+        "peak_replicas": peak_replicas,
+        "final_replicas": final_replicas,
+        "scale_events": [
+            {"t": round(r["t"], 3), "action": r["action"],
+             "rule": r["rule"], "replicas": r["replicas"],
+             "target": r["target"], "victim": r["victim"],
+             "burn_fast": round(r["inputs"]["burn_fast"], 3)}
+            for r in mgr.decision_log if r["action"] != "hold"],
+        "placements": mgr.placement_log,
+    }
+
+    out = {
+        "metric": "SLO burn + throughput vs fleet size under a fixed "
+                  "pipelined load (serving/fleet autoscaler)",
+        "config": {
+            "rows": n, "window": AUTOSCALE_WINDOW, "k": AUTOSCALE_K,
+            "x_dim": D, "max_batch": 1,
+            "objective_latency_s": round(obj_s, 6),
+            "objective_note": "calibrated: 2x warm single-row p50 on this "
+                              "host, so burns compare across machines",
+            "latency_target": objective.latency_target,
+            "autoscale": {"scale_up_burn": cfg.scale_up_burn,
+                          "scale_down_burn": cfg.scale_down_burn,
+                          "up_cooldown_s": cfg.up_cooldown_s,
+                          "down_cooldown_s": cfg.down_cooldown_s,
+                          "fast_window_s": fast_s, "slow_window_s": slow_s},
+        },
+        "static_curve": curve,
+        "elastic": elastic,
+        "bitwise_parity_across_legs": True,
+        "host": {
+            "cpu_count": os.cpu_count(),
+            "note": None if (os.cpu_count() or 1) >= max(AUTOSCALE_SIZES)
+            else (f"{os.cpu_count()}-core host: in-process replicas share "
+                  f"one XLA CPU thread pool, so fleet size cannot add "
+                  f"compute here — the burn curve is honestly flat and "
+                  f"the elastic leg's trajectory/parity are the signal; "
+                  f"a multi-core/TPU bench round resolves the slope"),
+        },
+    }
+    print(json.dumps({"metric": out["metric"],
+                      "static_curve": curve,
+                      "elastic": {k: elastic[k] for k in (
+                          "rows_per_sec", "peak_replicas",
+                          "final_replicas")},
+                      "scale_events": len(elastic["scale_events"])}))
+    res_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results")
+    try:
+        os.makedirs(res_dir, exist_ok=True)
+        with open(os.path.join(res_dir, "autoscale_bench.json"), "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    except OSError:
+        pass
+
+
 def main():
     import sys
 
@@ -2499,6 +2729,9 @@ def main():
         return
     if "--precision" in sys.argv:
         bench_precision()
+        return
+    if "--autoscale" in sys.argv:
+        bench_autoscale()
         return
     rates, rates_f32, rates_before, eval_rates, compile_info = bench_jax()
     base_sps, base_n = bench_baseline()

@@ -43,6 +43,7 @@ process (telemetry/exporters.py).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -224,6 +225,50 @@ def build_argparser() -> argparse.ArgumentParser:
                            "per-request k through these values (e.g. "
                            "'50,500,5000') — the closed-loop driver for "
                            "the large-k path; reports per-k latency")
+    scale = ap.add_argument_group(
+        "elastic fleet (serving/fleet/; needs --replicas)")
+    scale.add_argument("--autoscale", action="store_true",
+                       help="run the SLO-driven autoscaler: a control "
+                            "thread reads the tier's burn-rate gauges and "
+                            "scales the fast-replica count between "
+                            "--autoscale-min and --autoscale-max (scale-up "
+                            "joins warm via the persistent caches; "
+                            "scale-down drains — no accepted request is "
+                            "ever lost, results stay bitwise identical to "
+                            "a fixed fleet)")
+    scale.add_argument("--autoscale-min", dest="autoscale_min", type=int,
+                       default=1,
+                       help="lower replica bound (default 1)")
+    scale.add_argument("--autoscale-max", dest="autoscale_max", type=int,
+                       default=None,
+                       help="upper replica bound (default: 2x --replicas)")
+    scale.add_argument("--autoscale-up-burn", dest="autoscale_up_burn",
+                       type=float, default=1.0,
+                       help="fast-window worst burn rate at/above which "
+                            "the fleet grows (default 1.0: the error "
+                            "budget burns faster than it refills)")
+    scale.add_argument("--autoscale-down-burn", dest="autoscale_down_burn",
+                       type=float, default=0.25,
+                       help="fast-window burn at/below which an idle fleet "
+                            "shrinks; the gap up to --autoscale-up-burn is "
+                            "the hysteresis band")
+    scale.add_argument("--autoscale-up-cooldown-s",
+                       dest="autoscale_up_cooldown_s", type=float,
+                       default=30.0,
+                       help="minimum seconds between scale-ups")
+    scale.add_argument("--autoscale-down-cooldown-s",
+                       dest="autoscale_down_cooldown_s", type=float,
+                       default=120.0,
+                       help="minimum seconds from the last scale event (in "
+                            "either direction) to a scale-down")
+    scale.add_argument("--autoscale-interval-s",
+                       dest="autoscale_interval_s", type=float, default=1.0,
+                       help="control-loop tick period")
+    scale.add_argument("--autoscale-dry-run", dest="autoscale_dry_run",
+                       action="store_true",
+                       help="evaluate and log every scaling decision but "
+                            "never actuate (rehearsal mode; the decision "
+                            "log still lands in the shutdown snapshot)")
     ap.add_argument("--interactive", action="store_true",
                     help="serve JSON-lines requests from stdin instead of "
                          "synthetic load")
@@ -431,6 +476,37 @@ def _tier_mode(args, ops) -> int:
                        tracing=args.tracing)
     warm = tier.warmup(ops=ops)
     tier.start()
+    fleet = None
+    if args.autoscale:
+        from iwae_replication_project_tpu.serving.engine import ServingEngine
+        from iwae_replication_project_tpu.serving.fleet import (
+            AutoscaleConfig, FleetManager)
+
+        # the scale-up primitive: a NEW fast engine over the first fast
+        # replica's shared params — with the persistent XLA/autotune
+        # caches active its warmup deserializes instead of compiling, so
+        # it joins warm (the 0-fresh-compiles contract the smoke pins)
+        first = next(e for e in tier.router.engines
+                     if not getattr(e, "sharded", False))
+
+        def factory(first=first, knobs=_engine_knobs(args)):
+            return ServingEngine(
+                params=first._params, model_config=first.cfg, k=first.k,
+                k_max=first.k_max, precision=first.precision,
+                model=getattr(first, "model", None), **knobs)
+
+        fleet = FleetManager(tier, factory, AutoscaleConfig(
+            min_replicas=max(1, args.autoscale_min),
+            max_replicas=(args.autoscale_max
+                          if args.autoscale_max is not None
+                          else max(2 * args.replicas, args.autoscale_min)),
+            scale_up_burn=args.autoscale_up_burn,
+            scale_down_burn=args.autoscale_down_burn,
+            up_cooldown_s=args.autoscale_up_cooldown_s,
+            down_cooldown_s=args.autoscale_down_cooldown_s,
+            interval_s=args.autoscale_interval_s,
+            dry_run=args.autoscale_dry_run,
+            seed=args.seed)).start()
     metrics_srv = None
     if args.metrics_port is not None:
         from iwae_replication_project_tpu.telemetry import (
@@ -452,7 +528,9 @@ def _tier_mode(args, ops) -> int:
                  "host": args.host,
                  "models": sorted(info["models"]),
                  "default_model": info["default_model"],
-                 "quota": info["quota"]},
+                 "quota": info["quota"],
+                 "autoscale": (dataclasses.asdict(fleet.config)
+                               if fleet is not None else None)},
         "warmup": warm,
         "buckets": info["buckets"], "k": info["k"],
         "metrics_port": (metrics_srv.server_address[1]
@@ -462,7 +540,9 @@ def _tier_mode(args, ops) -> int:
             pass
     except KeyboardInterrupt:
         pass
-    tier.stop()
+    if fleet is not None:
+        fleet.stop()            # the control thread first: no scale event
+    tier.stop()                 # may race the tier drain
     if metrics_srv is not None:
         metrics_srv.shutdown()
     snap = tier.registry.snapshot()
@@ -470,6 +550,7 @@ def _tier_mode(args, ops) -> int:
         "router": {k: v for k, v in snap["counters"].items()
                    if k.startswith("router/")},
         "replicas": tier.router.replica_states(),
+        "fleet": fleet.doc() if fleet is not None else None,
         "engines": [e.metrics.snapshot()["counters"]
                     for e in tier.router.engines]}), flush=True)
     return 0

@@ -1,0 +1,59 @@
+"""Elastic fleet: the SLO-driven control loop over the serving tier.
+
+This package closes the loop the rest of the serving stack spent five PRs
+instrumenting: the burn-rate gauges (telemetry/slo.py), the model-affinity
+router with dynamic replica add/remove (frontend/router.py), the
+``static_cost``-billed executable store with placement pins
+(utils/compile_cache.py), the persistent XLA/autotune caches that make a
+joining replica warm, and the lossless drain contract that makes a leaving
+one invisible. The loop itself is four small modules, one concern each:
+
+    signals.py ──► controller.py ──► lifecycle.py ──► planner.py
+     (observe)       (decide)         (actuate)       (re-place)
+
+* :mod:`.signals` — one :class:`SignalSnapshot` per control tick: the SLO
+  burn-rate reductions (worst burn per window, trailing request counts),
+  replica states, outstanding work, store residency — from a local tier or
+  from the ``slo`` wire control op of a child tier (fleet-of-fleets);
+* :mod:`.controller` — :class:`AutoscaleController`: the pure decision
+  function (snapshot, config, seed) → :class:`Decision`, with hysteresis
+  (up-threshold above down-threshold), per-direction cooldowns, bounds,
+  dry-run, and a structured decision log;
+* :mod:`.planner` — :func:`plan_placement`: deterministic first-fit-
+  decreasing bin-packing of models onto replica store budgets using the
+  per-model ``static_cost`` peak-bytes cost model — which executables live
+  resident where;
+* :mod:`.lifecycle` — :class:`FleetManager`: actuates decisions against a
+  live tier (warm scale-up via a replica factory, drain-based scale-down
+  via :meth:`~..frontend.router.ReplicaRouter.remove_replica`), applies
+  each placement plan as store model-pins + router affinity hints, and
+  runs the periodic control thread behind ``iwae-serve --autoscale``.
+
+The invariant every piece preserves: seeds are minted at tier admission in
+arrival order, before any replica is chosen — so a fleet that scaled up,
+scaled down, or lost a replica mid-scale-event returns bitwise the same
+results as one that never changed shape (pinned by tests/test_fleet.py and
+``scripts/autoscale_smoke.py``).
+"""
+
+from iwae_replication_project_tpu.serving.fleet.controller import (
+    AutoscaleConfig,
+    AutoscaleController,
+    Decision,
+    choose_victim,
+)
+from iwae_replication_project_tpu.serving.fleet.lifecycle import FleetManager
+from iwae_replication_project_tpu.serving.fleet.planner import (
+    PlacementPlan,
+    plan_placement,
+)
+from iwae_replication_project_tpu.serving.fleet.signals import (
+    SignalSnapshot,
+    local_signals,
+    wire_signals,
+)
+
+__all__ = ["AutoscaleConfig", "AutoscaleController", "Decision",
+           "choose_victim", "FleetManager", "PlacementPlan",
+           "plan_placement", "SignalSnapshot", "local_signals",
+           "wire_signals"]

@@ -456,6 +456,12 @@ class TierClient:
         replica health, per-engine counters)."""
         return self._control("stats")
 
+    def slo(self) -> Dict[str, Any]:
+        """The tier's ``slo`` control document: ``{"enabled": bool,
+        "slo": {per-(model, op) burn rates}}`` — the autoscaler's wire
+        signal (:func:`~..fleet.signals.wire_signals` consumes it)."""
+        return self._control("slo")
+
     def traces(self, limit: Optional[int] = None,
                trace_id: Optional[str] = None,
                fmt: Optional[str] = None) -> Dict[str, Any]:

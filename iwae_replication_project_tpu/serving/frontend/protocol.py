@@ -99,8 +99,11 @@ from typing import Any, Dict, Optional
 ERROR_CODES = ("bad_request", "overloaded", "quota_exceeded", "timeout",
                "unavailable", "internal")
 
-#: protocol ops the front end answers itself (never routed to a replica)
-CONTROL_OPS = ("info", "stats", "traces")
+#: protocol ops the front end answers itself (never routed to a replica):
+#: capability/info, counters, retained traces, and the SLO burn-rate
+#: document (``slo`` — the scaling signal a fleet-of-fleets parent reads
+#: over the wire instead of scraping Prometheus text)
+CONTROL_OPS = ("info", "stats", "traces", "slo")
 
 #: max accepted request line (bytes) — a framing bound, not a row bound:
 #: 64 MiB comfortably fits a max_batch x 784-float payload and stops a

@@ -330,6 +330,32 @@ class RemoteEngine:
         (serving/cli.py `_tier_mode`); there is nothing to compile here."""
         return {}
 
+    def slo(self, timeout_s: float = 10.0) -> Dict[str, Any]:
+        """The child tier's ``slo`` control document (``{"enabled": bool,
+        "slo": <SLOMonitor.snapshot()>}``) — the scaling signal a fleet-of-
+        fleets parent's autoscaler reads over the wire (telemetry/slo.py's
+        ``peak_burns``/``window_requests`` reduce it identically to a local
+        snapshot). Blocks up to ``timeout_s``; a dead/poisoned proxy raises
+        :class:`ReplicaUnavailable`, exactly like :meth:`submit`."""
+        self._reconnect_if_needed()
+        fut: Future = Future()
+        with self._lock:
+            if self._dead is not None:
+                raise ReplicaUnavailable(
+                    f"remote tier {self._addr[0]}:{self._addr[1]} is gone "
+                    f"({self._dead})")
+            self._next_id += 1
+            req = {"op": "slo", "id": self._next_id}
+            self._pending[self._next_id] = fut
+            try:
+                self._sock.sendall(protocol.encode_line(req))  # iwaelint: disable=blocking-call-under-lock -- same frame-serializer rule as submit: id allocation, pending registration, and the send are atomic per request
+            except OSError as e:
+                del self._pending[self._next_id]
+                self._dead = f"send failed: {e}"
+                raise ReplicaUnavailable(
+                    f"remote tier send failed: {e}") from None
+        return fut.result(timeout=timeout_s)
+
     # -- receive side --------------------------------------------------------
 
     def _read_loop(self, reader: protocol.LineReader, gen: int) -> None:

@@ -59,7 +59,21 @@ id) and the per-replica engines:
   via ``engine.stop()``, waits for the outstanding count to reach zero,
   and error-completes any leftover future with
   :class:`ReplicaUnavailable`: every accepted request gets a result or a
-  typed error, never silence.
+  typed error, never silence;
+* **elastic fleet shape** — :meth:`add_replica` joins a new engine at a
+  stable, monotonically-assigned index (indices are never reused, so
+  affinity maps and per-replica gauges stay unambiguous across the
+  fleet's whole history), and :meth:`remove_replica` retires one through
+  the same drain contract as shutdown: the replica stops taking new work,
+  its engine is flushed, and anything it still holds is rerouted to peers
+  *with the original seeds* — an accepted request resolves identically
+  whether the fleet grew, shrank, or held still, because seeds are minted
+  in admission order before any replica is chosen. Every shape change
+  recomputes the fleet capability snapshot (``k_max``, ``models``,
+  large-k classification, ``default_model`` — which is sticky while its
+  model is still served, so model-less traffic never silently switches
+  weights mid-flight) and prunes affinity entries pointing at departed
+  replicas. The fleet autoscaler (``serving/fleet``) drives both.
 
 Observability: one :class:`~...telemetry.registry.MetricRegistry` per
 router — ``router/inflight/r<i>`` and ``router/healthy/r<i>`` gauges per
@@ -148,13 +162,17 @@ class _Replica:
     mutable field is guarded by the owning router's single lock, so the
     fleet has one synchronization domain, not N+1."""
 
-    __slots__ = ("index", "engine", "healthy", "outstanding", "last_error",
-                 "sharded", "k_max", "ops", "model", "models", "traces")
+    __slots__ = ("index", "engine", "healthy", "draining", "outstanding",
+                 "last_error", "sharded", "k_max", "ops", "model", "models",
+                 "traces")
 
     def __init__(self, index: int, engine):
         self.index = index
         self.engine = engine
         self.healthy = True
+        #: set by remove_replica: the replica finishes what it holds but
+        #: takes no new work (excluded by _select, never warm-probed back)
+        self.draining = False
         #: ticket -> _Tracked currently dispatched here (inflight = len)
         self.outstanding: Dict[int, _Tracked] = {}
         self.last_error: Optional[str] = None
@@ -222,56 +240,34 @@ class ReplicaRouter:
         self._clock = clock
         self._lock = threading.Lock()
         self._empty = threading.Condition(self._lock)
-        self._replicas = [_Replica(i, e) for i, e in enumerate(engines)]
-        # large-k classification (module docstring): the threshold above
-        # which score requests require a sharded replica. Default: the
-        # fast replicas' smallest k_max — the fast path serves everything
-        # it legally can, the mesh takes the rest. None when the fleet is
-        # homogeneous (nothing to classify).
-        self._has_fast = any(not r.sharded for r in self._replicas)
-        has_sharded = any(r.sharded for r in self._replicas)
-        if not has_sharded:
-            # no mesh-backed replica: nothing to classify onto — requests
-            # are bounded by k_max alone (a threshold here would turn
-            # valid k into spurious unavailable errors)
-            self.large_k_threshold = None
-        elif large_k_threshold is not None:
-            self.large_k_threshold: Optional[int] = int(large_k_threshold)
-        elif self._has_fast:
-            fast_maxes = [r.k_max for r in self._replicas
-                          if not r.sharded and r.k_max is not None]
-            # no fast replica exposes a bound (e.g. RemoteEngine proxies):
-            # fall back to NO classification rather than 0 — a 0 threshold
-            # would push every explicit-k request onto the sharded class
-            # and starve a perfectly capable fast path
-            self.large_k_threshold = min(fast_maxes) if fast_maxes else None
-        else:
-            self.large_k_threshold = None
-        #: the tier-wide k admission bound (None = engines enforce theirs):
-        #: max over replica k_max — a request k above it gets a synchronous
-        #: ValueError (typed bad_request), never an internal error
-        k_maxes = [r.k_max for r in self._replicas if r.k_max is not None]
-        self.k_max: Optional[int] = max(k_maxes) if k_maxes else None
-        #: the union of declared model capabilities over the fleet (empty =
-        #: unlabeled single-model fleet) — the typed-bad_request universe
-        self.models: frozenset = frozenset().union(
-            *(r.models for r in self._replicas if r.models is not None)) \
-            if any(r.models for r in self._replicas) else frozenset()
-        #: whether any replica still serves model-less traffic
-        self._has_unlabeled = any(r.models is None for r in self._replicas)
+        # the replica list is COPY-ON-WRITE: every shape change (join,
+        # removal) rebinds self._replicas to a fresh list under the lock,
+        # so lock-free readers (serves_op, drain's flush walk) always
+        # iterate one coherent snapshot. Replica indices are stable and
+        # monotonic — never list positions — and _by_index is the only
+        # index -> replica resolution (affinity maps survive removals).
+        self._replicas: List[_Replica] = \
+            [_Replica(i, e) for i, e in enumerate(engines)]
+        self._by_index: Dict[int, _Replica] = \
+            {r.index: r for r in self._replicas}
+        self._next_index = len(self._replicas)
+        #: the constructor's explicit large-k threshold, if any — honored
+        #: verbatim across every fleet-shape recompute
+        self._large_k_explicit = large_k_threshold
+        self._affinity: Dict[Tuple, int] = {}
         #: where a model-less request lands in an all-labeled fleet: the
         #: FIRST replica's default model — resolved at admission so results
-        #: are a pure function of the request, never of replica choice
-        self.default_model: Optional[str] = next(
-            (r.model for r in self._replicas if r.model is not None), None)
-        self._affinity: Dict[Tuple, int] = {}
+        #: are a pure function of the request, never of replica choice.
+        #: STICKY across fleet-shape changes while its model is still
+        #: served (see _recompute_locked).
+        self.default_model: Optional[str] = None
         self._seed_counter = 0
         self._ticket_counter = 0
         self._outstanding_total = 0
         self._closed = False
         self._monitor: Optional[threading.Thread] = None
         self._monitor_stop = threading.Event()
-        self.registry.gauge("router/replicas").set(len(self._replicas))
+        self._recompute_locked()
         self.registry.gauge("router/outstanding").set(0)
         for r in self._replicas:
             self._publish_replica(r)
@@ -294,6 +290,173 @@ class ReplicaRouter:
         self.registry.gauge(f"router/healthy/r{r.index}").set(
             1 if r.healthy else 0)
 
+    # -- fleet capability snapshot -------------------------------------------
+
+    def _recompute_locked(self) -> None:
+        """Recompute the fleet-wide capability snapshot from the CURRENT
+        replica list (caller holds the lock, or is __init__). Runs on
+        construction and on every fleet-shape change, so admission bounds
+        (``k_max``), the model universe, and the large-k classification
+        always describe the replicas that can actually serve — a stale
+        snapshot would reject valid requests or admit impossible ones.
+
+        * ``large_k_threshold`` — an explicit constructor threshold is
+          honored verbatim; the derived default (the fast replicas'
+          smallest ``k_max``) is re-derived, and it collapses to None when
+          the fleet has no sharded replica left (nothing to classify onto —
+          a threshold then would turn valid k into spurious unavailable
+          errors) or when no fast replica exposes a bound (a 0 threshold
+          would push everything onto the sharded class);
+        * ``default_model`` is STICKY: while the current default's model is
+          still served somewhere, it stays — re-deriving it from the (new)
+          first replica would silently switch which weights serve
+          model-less traffic mid-stream, breaking the results-are-a-pure-
+          function-of-the-request contract. Only when the default's model
+          leaves the fleet entirely is it re-resolved;
+        * affinity entries pointing at departed replicas are pruned (the
+          live ones keep their stable indices, so they stay valid).
+        """
+        reps = self._replicas
+        self._has_fast = any(not r.sharded for r in reps)
+        has_sharded = any(r.sharded for r in reps)
+        if not has_sharded:
+            self.large_k_threshold: Optional[int] = None
+        elif self._large_k_explicit is not None:
+            self.large_k_threshold = int(self._large_k_explicit)
+        elif self._has_fast:
+            fast_maxes = [r.k_max for r in reps
+                          if not r.sharded and r.k_max is not None]
+            self.large_k_threshold = min(fast_maxes) if fast_maxes else None
+        else:
+            self.large_k_threshold = None
+        #: the tier-wide k admission bound (None = engines enforce theirs):
+        #: max over replica k_max — a request k above it gets a synchronous
+        #: ValueError (typed bad_request), never an internal error
+        k_maxes = [r.k_max for r in reps if r.k_max is not None]
+        self.k_max: Optional[int] = max(k_maxes) if k_maxes else None
+        #: the union of declared model capabilities over the fleet (empty =
+        #: unlabeled single-model fleet) — the typed-bad_request universe
+        self.models: frozenset = frozenset().union(
+            *(r.models for r in reps if r.models is not None)) \
+            if any(r.models for r in reps) else frozenset()
+        self._has_unlabeled = any(r.models is None for r in reps)
+        if self.default_model is None or self.default_model not in self.models:
+            self.default_model = next(
+                (r.model for r in reps if r.model is not None), None)
+        self._affinity = {g: i for g, i in self._affinity.items()
+                          if i in self._by_index}
+        self.registry.gauge("router/replicas").set(len(reps))
+
+    # -- fleet shape: join + drain-based removal -----------------------------
+
+    def add_replica(self, engine) -> int:
+        """Join ``engine`` as a new replica; returns its stable index.
+
+        The engine is expected to arrive warm (built over shared params
+        with the persistent XLA/autotune caches active, so its first
+        dispatches deserialize instead of compiling — the fleet
+        autoscaler's scale-up contract); the router itself only snapshots
+        its capabilities and folds them into the fleet-wide bounds.
+        Existing traffic is untouched: seeds were minted at admission, so
+        work the new replica picks up returns bitwise what any peer would
+        have returned.
+        """
+        with self._lock:
+            if self._closed:
+                raise ReplicaUnavailable(
+                    "serving tier is draining; no new replicas")
+            index = self._next_index
+            self._next_index += 1
+            r = _Replica(index, engine)
+            self._replicas = self._replicas + [r]     # copy-on-write
+            self._by_index[index] = r
+            self._publish_replica(r)
+            self._recompute_locked()
+        return index
+
+    def remove_replica(self, index: int, timeout_s: float = 30.0):
+        """Retire replica ``index`` through the drain contract; returns its
+        engine (the caller owns disposal — the fleet autoscaler keeps it
+        for teardown).
+
+        The replica is first marked draining (it finishes what it holds
+        but is never selected again), then its engine is flushed via
+        ``engine.stop()`` — queued work dispatches and every in-flight
+        future completes. After the outstanding set empties (or
+        ``timeout_s`` passes — e.g. the replica died mid-removal), the
+        replica leaves the fleet, capabilities recompute, and anything it
+        still held is rerouted to peers *with the original seeds*: no
+        accepted request is ever lost to a scale-down, and results are
+        bitwise identical to a fleet that never shrank.
+        """
+        with self._lock:
+            r = self._by_index.get(index)
+            if r is None:
+                raise ValueError(f"no replica with index {index}")
+            if not any(x is not r and not x.draining
+                       for x in self._replicas):
+                raise ValueError("cannot remove the last replica")
+            if r.draining:
+                raise ValueError(f"replica r{index} is already draining")
+            r.draining = True
+        try:
+            # outside the lock: engine.stop() flushes queues and joins
+            # worker threads — foreign blocking work the router lock never
+            # nests around
+            r.engine.stop()
+        except Exception as e:
+            # the replica died mid-removal (the chaos schedule's favorite
+            # moment): the standard failure path reroutes its in-flight
+            # work with the original seeds; removal then proceeds
+            self._replica_failed(r, e)
+        deadline = self._clock() + timeout_s
+        with self._empty:
+            while r.outstanding:
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    break
+                self._empty.wait(timeout=min(remaining, 0.25))
+        with self._lock:
+            # steal only fully-dispatched leftovers — one still in its
+            # submit window belongs to the dispatching thread, which
+            # observes the unhealthy flag and reroutes it itself
+            r.healthy = False
+            leftovers = [t for t in r.outstanding.values()
+                         if not t.submitting]
+            for t in leftovers:
+                del r.outstanding[t.ticket]
+            self._replicas = [x for x in self._replicas if x is not r]
+            self._by_index.pop(index, None)
+            self._recompute_locked()
+            self.registry.gauge(f"router/inflight/r{index}").set(0)
+            self.registry.gauge(f"router/healthy/r{index}").set(0)
+        for t in leftovers:
+            self._count("reroutes")
+            self._finish_span(t, ReplicaUnavailable(
+                f"replica r{index} removed before the request completed"))
+            self._redispatch(t, exclude={index})
+        return r.engine
+
+    def prime_affinity(self, model: Optional[str], op: str,
+                       k: Optional[int], index: int) -> bool:
+        """Placement hint from the fleet planner: point the ``(model, op,
+        k)`` affinity group at replica ``index``, so the group's next
+        request lands on the replica whose store entries the placement
+        plan made resident there. A hint, not a constraint — load
+        imbalance beyond ``affinity_slack`` still overrides, exactly like
+        organically-earned affinity. Returns False (no-op) when the target
+        is gone, draining, or unhealthy."""
+        with self._lock:
+            return self._prime_affinity_locked(model, op, k, index)
+
+    def _prime_affinity_locked(self, model: Optional[str], op: str,
+                               k: Optional[int], index: int) -> bool:
+        r = self._by_index.get(index)
+        if r is None or r.draining or not r.healthy:
+            return False
+        self._affinity[(model, op, k)] = index
+        return True
+
     # -- introspection -----------------------------------------------------
 
     @property
@@ -307,15 +470,20 @@ class ReplicaRouter:
 
     def serves_op(self, op: str) -> bool:
         """Whether ANY replica serves `op` (capability sets are immutable
-        per engine, so no lock is needed — same basis as submit's check).
-        The front end's SLO accounting uses this to keep garbage op names
-        from minting burn-rate gauges."""
+        per engine and the replica list is copy-on-write, so a lock-free
+        read iterates one coherent snapshot — same basis as submit's
+        check). The front end's SLO accounting uses this to keep garbage
+        op names from minting burn-rate gauges."""
         return any(r.serves(op) for r in self._replicas)
 
     def replica_states(self) -> List[dict]:
         with self._lock:
             return [{"index": r.index, "healthy": r.healthy,
+                     "draining": r.draining,
                      "inflight": len(r.outstanding),
+                     "model": r.model,
+                     "models": sorted(r.models) if r.models is not None
+                     else None,
                      "last_error": r.last_error} for r in self._replicas]
 
     # -- request intake ----------------------------------------------------
@@ -438,15 +606,16 @@ class ReplicaRouter:
         over the replicas eligible for this (model, op, k) class."""
         model, op, k = group
         cands = [r for r in self._replicas
-                 if r.healthy and r.index not in exclude
+                 if r.healthy and not r.draining and r.index not in exclude
                  and self._eligible(r, op, k, model)]
         if not cands:
             return None
         least = min(len(r.outstanding) for r in cands)
         aff = self._affinity.get(group)
         if aff is not None:
-            ar = self._replicas[aff]
-            if ar.healthy and aff not in exclude and \
+            ar = self._by_index.get(aff)
+            if ar is not None and ar.healthy and not ar.draining and \
+                    aff not in exclude and \
                     self._eligible(ar, op, k, model) and \
                     len(ar.outstanding) <= least + self.affinity_slack:
                 self._count("affinity_hits")
@@ -698,7 +867,10 @@ class ReplicaRouter:
         its warmed program; a probe that completes in time re-admits the
         replica. Returns the number re-admitted."""
         with self._lock:
-            down = [r for r in self._replicas if not r.healthy]
+            # a draining replica is leaving the fleet: probing it back in
+            # would hand it new work mid-removal
+            down = [r for r in self._replicas
+                    if not r.healthy and not r.draining]
             if not down:
                 return 0
         readmitted = 0

@@ -163,9 +163,10 @@ class _Connection:
         req_id = obj.get("id")
         op = obj.get("op")
         if op in protocol.CONTROL_OPS:
-            doc = self._tier.info() if op == "info" else (
-                self._tier.stats() if op == "stats"
-                else self._tier.traces_doc(obj))
+            doc = {"info": self._tier.info,
+                   "stats": self._tier.stats,
+                   "slo": self._tier.slo_doc}[op]() \
+                if op != "traces" else self._tier.traces_doc(obj)
             self._write(protocol.ok_response(req_id, doc))
             return
         t_start = self._tier.clock()
@@ -506,6 +507,18 @@ class ServingTier:
         if obj.get("format") == "chrome":
             return chrome_trace_events(docs)
         return {"stats": stats, "traces": docs}
+
+    def slo_doc(self) -> Dict[str, Any]:
+        """The ``{"op": "slo"}`` control response: the SLOMonitor's
+        burn-rate + objective snapshot (telemetry/slo.py schema) — the
+        scaling signal the fleet autoscaler (and a fleet-of-fleets parent,
+        via :meth:`RemoteEngine.slo`) reads as JSON instead of scraping
+        the Prometheus text page. A tier with SLO accounting disabled
+        answers with empty state, not an error (same contract as
+        :meth:`traces_doc`)."""
+        if self.slo is None:
+            return {"enabled": False, "slo": {}}
+        return {"enabled": True, "slo": self.slo.snapshot()}
 
     # -- info ---------------------------------------------------------------
 

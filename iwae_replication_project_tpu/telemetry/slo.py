@@ -49,7 +49,7 @@ from typing import Callable, Dict, Optional, Sequence, Tuple
 from iwae_replication_project_tpu.telemetry.registry import MetricRegistry
 
 __all__ = ["SLOObjective", "SLOMonitor", "DEFAULT_ERROR_CODES",
-           "DEFAULT_WINDOWS"]
+           "DEFAULT_WINDOWS", "peak_burns", "window_requests"]
 
 #: typed protocol codes that count against the availability objective —
 #: the server-attributable half of protocol.ERROR_CODES
@@ -223,3 +223,39 @@ class SLOMonitor:
                 "windows": wins,
             }
         return out
+
+
+# -- snapshot reductions (the autoscaler's scalar signals) -------------------
+#
+# Pure functions over the snapshot() document — NOT monitor methods — so the
+# fleet controller applies the identical reduction to a local monitor's
+# snapshot and to one shipped over the wire by the `slo` control op (a
+# fleet-of-fleets parent scales children it only sees as JSON).
+
+def peak_burns(snapshot: Dict[str, dict]) -> Dict[str, float]:
+    """Worst burn rate per window label across every (model, op) key and
+    both objectives (latency and availability).
+
+    One scalar per window is what the scaling decision consumes: the fleet
+    must grow when ANY class burns its budget — averaging across keys would
+    let a small hot tenant drown under a large cold one. Empty snapshot
+    (no traffic yet) reads as 0.0 burns for no windows; callers treat a
+    missing label as burn 0."""
+    out: Dict[str, float] = {}
+    for doc in snapshot.values():
+        for label, win in doc.get("windows", {}).items():
+            burn = max(float(win.get("latency_burn", 0.0)),
+                       float(win.get("availability_burn", 0.0)))
+            out[label] = max(out.get(label, 0.0), burn)
+    return out
+
+
+def window_requests(snapshot: Dict[str, dict]) -> Dict[str, int]:
+    """Total requests per window label summed across keys — the idleness
+    half of the scaling signal (a fleet with zero trailing-window traffic
+    and no burn is a scale-down candidate)."""
+    out: Dict[str, int] = {}
+    for doc in snapshot.values():
+        for label, win in doc.get("windows", {}).items():
+            out[label] = out.get(label, 0) + int(win.get("requests", 0))
+    return out

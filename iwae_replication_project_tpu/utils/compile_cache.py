@@ -311,6 +311,26 @@ class _PrefixPin:
             self._store._unpin_prefix(self._prefix)
 
 
+class _ModelPin:
+    """Handle for a model-level placement pin (release once)."""
+
+    __slots__ = ("_store", "_model", "_released")
+
+    def __init__(self, store: "ExecutableStore", model: str):
+        self._store = store
+        self._model = model
+        self._released = False
+
+    @property
+    def model(self) -> str:
+        return self._model
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._store._unpin_model(self._model)
+
+
 class ExecutableStore:
     """Capacity-bounded, multi-tenant AOT executable store.
 
@@ -359,6 +379,10 @@ class ExecutableStore:
         self._demoted: Dict[Tuple, Optional[dict]] = {}
         #: active (model, name, build_key) prefix pins (refcounted)
         self._prefix_pins: Dict[Tuple, int] = {}
+        #: active model-level placement pins (refcounted): the fleet
+        #: placement planner's residency decision — every entry under a
+        #: pinned model is unevictable until release
+        self._model_pins: Dict[str, int] = {}
         self._budget = int(budget_bytes) if budget_bytes is not None else None
         self._resident = 0
         self._counters = {n: 0 for n in self.COUNTER_NAMES}
@@ -431,7 +455,8 @@ class ExecutableStore:
             self._publish_resident()
 
     def _pinned(self, key: Tuple, entry: _StoreEntry) -> bool:
-        return entry.pins > 0 or key[:3] in self._prefix_pins
+        return entry.pins > 0 or key[:3] in self._prefix_pins \
+            or key[0] in self._model_pins
 
     def _evict_over_budget(self) -> None:
         """Evict LRU unpinned entries until the resident set fits the
@@ -494,6 +519,49 @@ class ExecutableStore:
             yield
         finally:
             pin.release()
+
+    def pin_model(self, model: Optional[str]) -> _ModelPin:
+        """Pin every entry (present or future) under ``model`` against
+        eviction; returns the release handle. This is the fleet placement
+        planner's residency primitive: a model the cost-model bin-packer
+        placed resident stays warm through budget pressure from other
+        tenants' traffic until the next placement plan releases it. The
+        per-dispatch :meth:`pin_prefix` pins compose independently —
+        releasing a model pin never unpins in-flight work."""
+        model = model if model is not None else DEFAULT_MODEL
+        with self._lock:
+            self._model_pins[model] = self._model_pins.get(model, 0) + 1
+        return _ModelPin(self, model)
+
+    def _unpin_model(self, model: str) -> None:
+        with self._lock:
+            n = self._model_pins.get(model, 0) - 1
+            if n <= 0:
+                self._model_pins.pop(model, None)
+            else:
+                self._model_pins[model] = n
+            # same deferred-eviction rule as prefix-pin release: only pay
+            # the scan when the resident set actually sits over budget
+            if self._budget is not None and self._resident > self._budget:
+                self._evict_over_budget()
+                self._publish_resident()
+
+    def model_pins(self) -> Dict[str, int]:
+        """The active model-level pin refcounts (snapshot) — the placement
+        smoke/tests assert the plan actually landed."""
+        with self._lock:
+            return dict(self._model_pins)
+
+    def model_costs(self) -> Dict[str, int]:
+        """Per-model resident cost: the sum of each resident entry's billed
+        bytes (static-cost ``peak_bytes``, arg-bytes fallback) keyed by
+        model label. The fleet placement planner's cost model: what one
+        replica pays in store budget to keep a model's working set warm."""
+        with self._lock:
+            costs: Dict[str, int] = {}
+            for key, e in self._entries.items():
+                costs[key[0]] = costs.get(key[0], 0) + e.bytes
+            return costs
 
     # -- resolution ----------------------------------------------------------
 
@@ -607,6 +675,7 @@ class ExecutableStore:
                     "budget_bytes": self._budget,
                     "entries": len(self._entries),
                     "demoted": len(self._demoted),
+                    "model_pins": dict(self._model_pins),
                     "per_model": per_model}
 
     def signatures(self) -> List[Tuple]:

@@ -1,0 +1,268 @@
+"""Elastic-fleet smoke stage for scripts/check.py: the autoscaler, live.
+
+One short CPU process that runs a real tiny-engine tier under the
+SLO-driven autoscaler (serving/fleet/) with a seeded chaos schedule, and
+proves the ISSUE's composed claims end to end:
+
+1. **burn breach -> warm scale-up** — a burst against a deliberately
+   unbeatable latency objective pushes the fast+slow burn windows past
+   the threshold; the controller decides "up" (rule ``burn-breach``) and
+   the joined replica is built over the SHARED params and warmed through
+   the process executable store + persistent caches: the ``cache_stats``
+   delta across the ENTIRE elastic run — both joins included — shows
+   **zero fresh compiles** (``aot_misses == 0``,
+   ``persistent_cache_misses == 0``);
+2. **replica killed mid-scale-event** — the chaos schedule crashes the
+   freshly-joined replica on its FIRST serving launch, exactly when the
+   post-scale-event burst leans on it (the PR 10 fault shape; the
+   pre-join warmup never touches the launch site, so the join itself
+   lands); the router reroutes its work with the ORIGINAL admission
+   seeds, the controller sees the shrunken live fleet still burning and
+   scales up AGAIN (a second warm join), and not one request is lost;
+3. **idle -> drain-based scale-down** — once the burn windows rotate
+   clean and nothing is outstanding, the controller decides "down" (rule
+   ``idle``); the victim leaves through the router's drain contract and
+   the shrunk fleet keeps serving;
+4. **bitwise parity vs a static fleet** — every response of the elastic
+   run equals, bitwise, a fixed single-replica tier's response for the
+   same admission order: seeds are minted at admission, so fleet shape
+   moves warmth and capacity, never results.
+
+The decision log, placement log, and fault log are committed to
+``results/autoscale_smoke.json``. Exit 0 on success, 1 with a message on
+the first failed check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SEED = 1234
+N_PHASE = 12          # requests per burst phase (4 phases = 48 total)
+
+# short real-time burn windows so idle actually rotates the violations
+# out within the smoke's budget; labels stay "5m"/"1h" — the controller
+# addresses windows by label, and these ARE its fast/slow pair here
+FAST_S, SLOW_S = 2.0, 4.0
+
+
+def _tiny_fleet():
+    import jax
+
+    from iwae_replication_project_tpu.models import iwae as model
+    from iwae_replication_project_tpu.serving import ServingEngine
+
+    D = 32
+    cfg = model.ModelConfig(x_dim=D, n_hidden_enc=(16, 8),
+                            n_latent_enc=(8, 4), n_hidden_dec=(8, 16),
+                            n_latent_dec=(8, D))
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+
+    def engine():
+        return ServingEngine(params=params, model_config=cfg, k=4,
+                             max_batch=8, max_inflight=2, timeout_s=30.0)
+
+    return engine, D
+
+
+def _burst(cli, rows, lo, hi):
+    """Pipeline rows[lo:hi] on one connection (admission order == submit
+    order) and return their responses in submit order."""
+    ids = [cli.submit("score", [rows[i].tolist()]) for i in range(lo, hi)]
+    done = cli.drain(ids)
+    return [done[rid] for rid in ids]
+
+
+def _wait(pred, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"smoke timed out waiting for {msg}")
+        time.sleep(0.01)
+
+
+def main() -> int:
+    import numpy as np
+
+    from iwae_replication_project_tpu.serving import faults
+    from iwae_replication_project_tpu.serving.fleet import (
+        AutoscaleConfig, FleetManager)
+    from iwae_replication_project_tpu.serving.frontend import (
+        ServingTier, TierClient)
+    from iwae_replication_project_tpu.telemetry.slo import (
+        SLOMonitor, SLOObjective)
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        cache_stats, setup_persistent_cache, stats_delta)
+
+    # warm-path discipline, like every entry point: repeated CI runs
+    # deserialize the serving programs instead of recompiling
+    setup_persistent_cache(base_dir=REPO)
+
+    engine, D = _tiny_fleet()
+    rng = np.random.RandomState(0)
+    n = 4 * N_PHASE
+    rows = (rng.rand(n, D) > 0.5).astype(np.float32)
+
+    # -- static reference fleet: same rows, same admission order ----------
+    static = ServingTier([engine()], monitor_interval_s=0.05)
+    static.warmup(ops=("score",))
+    static.start()
+    try:
+        with TierClient("127.0.0.1", static.port) as cli:
+            ref_resps = _burst(cli, rows, 0, n)
+    finally:
+        static.stop(timeout_s=30)
+    assert all(r["ok"] for r in ref_resps), "static reference run errored"
+    ref = [r["result"][0] for r in ref_resps]
+
+    # -- elastic fleet: 1 replica + autoscaler, chaos installed -----------
+    # an unbeatable latency objective: every request violates, so the
+    # burst drives burn = 1.0 / (1 - target) = 100 >> threshold
+    slo = SLOMonitor(default=SLOObjective(latency_s=1e-6),
+                     windows=((FAST_S, "5m"), (SLOW_S, "1h")))
+    tier = ServingTier([engine()], slo=slo, monitor_interval_s=0.05)
+    tier.warmup(ops=("score",))
+    tier.start()
+
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=3,
+                          scale_up_burn=1.0, scale_down_burn=0.25,
+                          up_cooldown_s=0.0, down_cooldown_s=0.5,
+                          seed=SEED)
+    mgr = FleetManager(tier, engine, cfg, warmup_ops=("score",),
+                       drain_timeout_s=20.0)
+
+    # chaos: the FIRST replica the autoscaler joins is crashed on its
+    # first serving launch — dead exactly when the post-scale-event burst
+    # leans on it (times=None keeps it down; probes fail too). after=0,
+    # not after=1: a fully cache-warm fleet coalesces a whole pipelined
+    # burst into ONE launch per replica, so a second launch on the victim
+    # is not guaranteed — the first one is (the pre-join warmup never
+    # passes the launch site, so the join itself always lands)
+    joined: list = []
+    factory = mgr._factory
+
+    def tracked_factory():
+        e = factory()
+        joined.append(e)
+        return e
+
+    mgr._factory = tracked_factory
+    schedule = faults.FaultSchedule([faults.FaultRule(
+        site=faults.SITE_ENGINE_LAUNCH, after=0, times=None,
+        match=lambda ctx: bool(joined) and ctx.get("engine") is joined[0],
+        name="crash_replica",
+        action=faults.raise_fault("replica crash (chaos)"))], seed=SEED)
+
+    # everything from here runs against the warm store: the delta at the
+    # end covers both scale-up joins (warmup + serving dispatches)
+    s0 = cache_stats()
+
+    resps = []
+    summary = {"seed": SEED, "requests": n, "ok": False}
+    try:
+        with faults.installed(schedule):
+            with TierClient("127.0.0.1", tier.port, timeout_s=60.0) as cli:
+                # phase 1: breach burst on the 1-replica fleet
+                resps += _burst(cli, rows, 0, N_PHASE)
+                d1 = mgr.step()
+                assert d1.action == "up" and d1.rule == "burn-breach", \
+                    f"breach burst did not scale up: {d1}"
+                assert len(tier.router.engines) == 2, "join did not land"
+
+                # phase 2: steer the burst's affinity group at the joined
+                # replica (the placement-hint primitive the planner uses),
+                # so the chaos rule deterministically kills it mid-burst —
+                # one successful launch, then dead on the next
+                assert tier.router.prime_affinity(None, "score", None, 1)
+                resps += _burst(cli, rows, N_PHASE, 2 * N_PHASE)
+                _wait(lambda: schedule.fired("crash_replica") >= 1,
+                      msg="chaos crash on the joined replica")
+
+                # the controller sees a 1-live fleet still burning: up
+                # again — the SECOND warm join, mid-chaos
+                d2 = mgr.step()
+                assert d2.action == "up", \
+                    f"post-crash breach did not re-scale: {d2}"
+                _wait(lambda: sum(1 for s in tier.router.replica_states()
+                                  if s["healthy"] and not s["draining"])
+                      == 2, msg="second join live")
+
+                # phase 3: burst across the healed fleet
+                resps += _burst(cli, rows, 2 * N_PHASE, 3 * N_PHASE)
+
+                # idle: let the burn windows rotate clean, then the
+                # controller must shrink through the drain contract
+                time.sleep(FAST_S + 0.8)
+                d3 = mgr.step()
+                assert d3.action == "down" and d3.rule == "idle", \
+                    f"idle fleet did not scale down: {d3}"
+                live = [s for s in tier.router.replica_states()
+                        if s["healthy"] and not s["draining"]]
+                assert len(live) == 1, f"drain left extra live: {live}"
+                assert len(mgr.retired) == 1 and \
+                    mgr.retired[0] not in tier.router.engines
+
+                # phase 4: the shrunk fleet keeps serving
+                resps += _burst(cli, rows, 3 * N_PHASE, n)
+            stats = tier.stats()
+    finally:
+        mgr.stop()
+        tier.stop(timeout_s=30)
+
+    # -- verdicts ---------------------------------------------------------
+    assert len(resps) == n, f"lost responses: {len(resps)}/{n}"
+    assert all(r["ok"] for r in resps), \
+        [r for r in resps if not r["ok"]][:3]
+    got = [r["result"][0] for r in resps]
+    assert got == ref, \
+        "elastic-fleet results differ bitwise from the static fleet"
+    assert tier.router.outstanding == 0, "drain left requests outstanding"
+
+    d = stats_delta(s0)
+    assert d["aot_misses"] == 0, \
+        f"scale-up joins compiled fresh programs: {d}"
+    assert d.get("persistent_cache_misses", 0) == 0, \
+        f"scale-up joins missed the persistent cache: {d}"
+
+    r = stats["router"]
+    assert r["router/replica_failures"] >= 1, r
+    assert r["router/reroutes"] >= 1, r
+    actions = [rec["action"] for rec in mgr.decision_log]
+    assert actions.count("up") >= 2 and actions.count("down") >= 1, actions
+    assert any(p["event"] == "rebalance" for p in mgr.placement_log)
+
+    summary.update({
+        "ok": True,
+        "bitwise_parity_vs_static_fleet": True,
+        "fresh_compiles": {k: d.get(k, 0) for k in (
+            "aot_misses", "persistent_cache_misses")},
+        "router": {k: r[k] for k in ("router/routed",
+                                     "router/replica_failures",
+                                     "router/reroutes")},
+        "decisions": mgr.decision_log,
+        "placements": mgr.placement_log,
+        "fault_log": [list(e) for e in schedule.log],
+    })
+    out = os.path.join(REPO, "results", "autoscale_smoke.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump(summary, f, indent=2)
+        f.write("\n")
+    print(f"autoscale smoke OK: {n}/{n} bitwise == static fleet; "
+          f"2 warm joins (0 fresh compiles), 1 chaos kill absorbed, "
+          f"1 drain-based scale-down -> {os.path.relpath(out, REPO)}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"autoscale smoke FAILED: {e}", file=sys.stderr)
+        sys.exit(1)

@@ -41,32 +41,39 @@ machine-readable summary.
    replica beaten by a client hedge, SIGTERM-mid-stage + resume and
    truncated-checkpoint fallback both bitwise-identical to an
    uninterrupted run; summary committed to ``results/chaos_smoke.json``;
-12. **multi-model smoke** (scripts/multi_model_smoke.py) — a two-model zoo
+12. **autoscale smoke** (scripts/autoscale_smoke.py) — the elastic fleet
+   (serving/fleet/) live: a burn-breach burst scales up with a WARM join
+   (zero fresh compiles across both joins), the chaos schedule kills the
+   joined replica mid-scale-event (work rerouted, scaled up again), idle
+   scales down through the drain contract, and every response is bitwise
+   identical to a static fleet with the same admission order; decision +
+   placement + fault logs committed to ``results/autoscale_smoke.json``;
+13. **multi-model smoke** (scripts/multi_model_smoke.py) — a two-model zoo
    behind one tier over a real socket with the executable-store budget
    squeezed to one model's worth: forced eviction churn mid-burst, every
    response bitwise-correct vs dedicated single-model engines, zero
    fresh compiles once warm (evictions demote to the persistent cache
    and readmit by deserialization);
-13. **precision parity smoke** (scripts/precision_parity_smoke.py) — the
+14. **precision parity smoke** (scripts/precision_parity_smoke.py) — the
    low-precision serving contract: bf16/int8 legs pass the statistical
    acceptance gate (telemetry/parity.py) while a corrupted leg is
    rejected, explicit-fp32 policy stays bitwise, one tier serves fp32 +
    bf16 tenants of the same model with zero fresh compiles once warm,
    and int8 admission is honest (forced path stamps ``int8``; auto with
    no measured win serves the exact fp32 program);
-14. **trace smoke** (scripts/trace_smoke.py) — end-to-end request tracing
+15. **trace smoke** (scripts/trace_smoke.py) — end-to-end request tracing
    over a real socket: a ragged burst with a replica killed mid-burst
    plus a hedged request, every request yielding ONE coherent trace tree
    (client -> tier -> router attempts -> engine stages) in the
    tail-sampled flight recorder, results bitwise identical to a
    tracing-off tier, the ``traces`` wire op valid in raw and Chrome
    formats, and SLO burn-rate gauges live on the Prometheus page;
-15. **race smoke** (scripts/race_smoke.py) — the race detector's
+16. **race smoke** (scripts/race_smoke.py) — the race detector's
    instrumented-sync layer over the REAL tier/router/engine stack under
    >= 50 seeded perturbation schedules with a replica killed mid-burst:
    zero races, zero runtime leaks (open spans, store pins, undone
    futures), and results bitwise identical to an uninstrumented run;
-16. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
+17. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
    ``--sanitize`` armed.
 
 Every full-gate run writes ``results/check_summary.json`` (per-stage status,
@@ -240,6 +247,12 @@ def run_chaos_smoke() -> dict:
                                                   "chaos_smoke.py")])
 
 
+def run_autoscale_smoke() -> dict:
+    return run_step("autoscale smoke",
+                    [sys.executable, os.path.join("scripts",
+                                                  "autoscale_smoke.py")])
+
+
 def run_multi_model_smoke() -> dict:
     return run_step("multi-model smoke",
                     [sys.executable, os.path.join("scripts",
@@ -310,6 +323,7 @@ def main(argv=None) -> int:
         stages.append(run_hot_loop_smoke())
         stages.append(run_autotune_smoke())
         stages.append(run_chaos_smoke())
+        stages.append(run_autoscale_smoke())
         stages.append(run_multi_model_smoke())
         stages.append(run_precision_parity_smoke())
         stages.append(run_trace_smoke())

@@ -668,7 +668,7 @@ class TestCheckSummary:
         payload = json.loads(out.read_text())
         assert payload["ok"] is True
         assert [s["name"] for s in payload["stages"]] == \
-            ["lint", "audit", "cost"]
+            ["lint", "race", "audit", "cost"]
         for s in payload["stages"]:
             assert s["status"] == "ok" and s["findings"] == 0
             assert s["wall_seconds"] > 0

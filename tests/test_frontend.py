@@ -714,6 +714,96 @@ def test_parent_router_over_remote_tiers():
 
 
 # ---------------------------------------------------------------------------
+# elastic fleet shape: capability refresh + the slo control op
+# ---------------------------------------------------------------------------
+
+def _engine_with(mode="auto", model=None, k_max=None, sharded=False):
+    e = FakeEngine(mode)
+    if model is not None:
+        e.model = model
+        e.models = (model,)
+        # labeled engines take the model kwarg the router forwards
+        base = e.submit
+        e.submit = lambda op, row, k=None, *, seed=None, model=None: \
+            base(op, row, k, seed=seed)
+    if k_max is not None:
+        e.k_max = k_max
+    if sharded:
+        e.sharded = True
+    return e
+
+
+def test_fleet_grow_then_shrink_capability_refresh():
+    """The capability-snapshot pin: k_max / models / large-k classification
+    recompute on every fleet-shape change, and the default model is sticky
+    (a grown-then-shrunk fleet never silently reroutes model-less traffic
+    onto different weights)."""
+    fast = _engine_with(model="mnist", k_max=64)
+    r = ReplicaRouter([fast])
+    assert (r.k_max, r.large_k_threshold) == (64, None)
+    assert r.models == frozenset({"mnist"}) and r.default_model == "mnist"
+
+    big = r.add_replica(_engine_with(k_max=4096, sharded=True))
+    # a sharded replica joined: the large-k class exists now, and the
+    # fleet-wide k ceiling grew
+    assert (r.k_max, r.large_k_threshold) == (4096, 64)
+
+    omni = r.add_replica(_engine_with(model="omniglot", k_max=32))
+    assert r.models == frozenset({"mnist", "omniglot"})
+    assert r.large_k_threshold == 32     # min fast k_max splits the classes
+    assert r.default_model == "mnist"    # sticky through the growth
+
+    # traffic rides the grown fleet with admission-order seeds
+    got = [r.submit("score", [1.0, 0, 0, 0], k=(i % 3) + 1,
+                    model="mnist").result(timeout=5) for i in range(6)]
+    assert got == [i * 1000.0 + 1.0 for i in range(6)]
+
+    # shrink back: every capability bound recomputes downward too
+    r.remove_replica(omni)
+    assert r.models == frozenset({"mnist"}) and r.large_k_threshold == 64
+    r.remove_replica(big)
+    assert (r.k_max, r.large_k_threshold) == (64, None)
+    assert r.default_model == "mnist"
+    with pytest.raises(ValueError):
+        r.remove_replica(big)            # stable indices never recycle
+    with pytest.raises(ValueError):
+        r.remove_replica(0)              # the last replica never drains
+    r.drain(timeout_s=5)
+
+
+def test_slo_control_op_and_remote_forwarding(fake_tier):
+    """Satellite pin: the ``slo`` wire op returns the SLOMonitor snapshot
+    beside stats/traces, and RemoteEngine forwards it — a parent
+    autoscaler reads a child tier's burn rates as JSON."""
+    from iwae_replication_project_tpu.serving.fleet import wire_signals
+
+    tier, _ = fake_tier
+    with TierClient("127.0.0.1", tier.port) as c:
+        c.score([[1.0, 0, 0, 0]])
+        doc = c.slo()
+        assert doc["enabled"] is True and "score" in doc["slo"]
+        assert doc["slo"]["score"]["windows"]["5m"]["requests"] == 1
+    with RemoteEngine("127.0.0.1", tier.port) as rem:
+        rdoc = rem.slo()
+        assert rdoc["enabled"] is True and "score" in rdoc["slo"]
+        # the wire doc reduces into the controller's snapshot schema
+        snap = wire_signals(rdoc, replica_states=[
+            {"index": 0, "healthy": True, "draining": False, "inflight": 0}])
+        assert snap.requests_in("5m") >= 1 and snap.replicas == 1
+
+
+def test_slo_control_op_disabled_tier():
+    tier = ServingTier([FakeEngine("auto")], slo=False,
+                       monitor_interval_s=0.05)
+    tier.start()
+    try:
+        with TierClient("127.0.0.1", tier.port) as c:
+            assert c.slo() == {"enabled": False, "slo": {}}
+    finally:
+        tier.stop(timeout_s=10)
+
+
+# ---------------------------------------------------------------------------
 # real-engine integration: fleet parity + zero recompiles (the AOT pin)
 # ---------------------------------------------------------------------------
 
