@@ -1630,6 +1630,135 @@ def bench_tracing():
         pass
 
 
+PROFILING_ROWS = 240        # requests per closed-loop rep (ragged singles)
+PROFILING_REPS = 5          # paired, order-alternated reps per mode
+
+
+def bench_profiling():
+    """``--profiling``: measured overhead of the continuous profiling plane.
+
+    Two pipelined serving engines over the SAME tiny weights — profiling
+    off vs profiling on (the completion thread attributing every
+    dispatch's device interval, computing measured MFU/bandwidth against
+    explicit roofline peaks, and running the EWMA drift test) — fed the
+    identical closed-loop single-row request stream.  The tiny
+    host-dominated model measures the per-dispatch profiler cost at its
+    WORST case, exactly like ``--tracing``.
+
+    Committed claims (results/profiling_bench.json):
+
+    * **bitwise parity** — results identical across modes (profiling is
+      completion-thread metadata only: no extra sync, no program change);
+    * **overhead** — rows/sec per mode, the median paired wall ratio, and
+      the per-request cost in microseconds;
+    * **attribution accounting** — dispatches/keys attributed and the
+      drift detector's finding count (zero on a clean run).
+    """
+    import jax
+
+    from iwae_replication_project_tpu.models import iwae as model
+    from iwae_replication_project_tpu.serving import ServingEngine
+    from iwae_replication_project_tpu.telemetry.profiling import (
+        ProfilingConfig)
+
+    D = 32
+    cfg = model.ModelConfig(x_dim=D, n_hidden_enc=(16, 8),
+                            n_latent_enc=(8, 4), n_hidden_dec=(8, 16),
+                            n_latent_dec=(8, D))
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    xs = (rng.rand(PROFILING_ROWS, D) > 0.5).astype(np.float32)
+
+    def build(profiling):
+        # explicit peaks: CPU has no chip-table entry, and the MFU gauge
+        # math must run in the measured leg (it is part of the cost)
+        prof = ProfilingConfig(peak_flops=1e12, peak_hbm_bytes=1e11) \
+            if profiling else False
+        eng = ServingEngine(params=params, model_config=cfg, k=4,
+                            max_batch=8, max_inflight=2, timeout_s=None,
+                            profiling=prof)
+        eng.warmup(ops=("score",))
+        eng.start()
+        return {"eng": eng, "walls": [], "out": None}
+
+    def closed_loop(slot):
+        eng = slot["eng"]
+        t0 = time.perf_counter()
+        futs = [eng.submit("score", x) for x in xs]
+        out = np.array([f.result() for f in futs])
+        wall = time.perf_counter() - t0
+        return wall, out
+
+    modes = {"off": build(False), "on": build(True)}
+    # untimed warm round per mode, then paired order-alternated reps so
+    # machine noise hits both modes evenly; seeds advance identically
+    # (same submit count per round), so round j stays bitwise-comparable
+    for rep in range(-1, PROFILING_REPS):
+        order = list(modes) if rep % 2 else list(modes)[::-1]
+        for name in order:
+            wall, out = closed_loop(modes[name])
+            if rep < 0:
+                modes[name]["out"] = out
+            else:
+                modes[name]["walls"].append(wall)
+                modes[name]["out_last"] = out
+    import statistics
+    bitwise = (modes["off"]["out"].tobytes() == modes["on"]["out"].tobytes()
+               and modes["off"]["out_last"].tobytes()
+               == modes["on"]["out_last"].tobytes())
+    ratios = sorted(off / on for off, on in zip(modes["off"]["walls"],
+                                                modes["on"]["walls"]))
+    median_ratio = statistics.median(ratios)
+    best = {name: min(slot["walls"]) for name, slot in modes.items()}
+    prof = modes["on"]["eng"].profiler
+    snap = prof.snapshot()
+    for slot in modes.values():
+        slot["eng"].stop()
+
+    per_req_us = (best["on"] - best["off"]) / PROFILING_ROWS * 1e6
+    out = {
+        "metric": "continuous-profiling overhead (tiny score model, "
+                  "pipelined closed loop, per-dispatch attribution + "
+                  "MFU + EWMA drift test on the completion thread)",
+        "unit": "rows/sec + paired wall ratio (off/on; < 1 means "
+                "profiling costs time)",
+        "requests_per_rep": PROFILING_ROWS,
+        "reps": PROFILING_REPS,
+        "rows_per_sec_profiling_off": round(PROFILING_ROWS / best["off"], 2),
+        "rows_per_sec_profiling_on": round(PROFILING_ROWS / best["on"], 2),
+        # best-of walls (least-contended measurement on this shared box);
+        # the per-pair ratios + median keep the spread visible
+        "off_over_on_best": round(best["off"] / best["on"], 4),
+        "off_over_on_median_pair": round(median_ratio, 4),
+        "off_over_on_pairs": [round(r, 4) for r in ratios],
+        "overhead_pct_best": round(
+            (best["on"] - best["off"]) / best["off"] * 100.0, 2),
+        "overhead_us_per_request_best": round(per_req_us, 1),
+        "bitwise_identical": bool(bitwise),
+        "attribution": {
+            "keys": len(snap["keys"]),
+            "dispatches": int(sum(st["count"]
+                                  for st in snap["keys"].values())),
+            "drift_findings": len(snap["findings"]),
+            "mfu_live": any(st["last_mfu"] is not None
+                            for st in snap["keys"].values()),
+        },
+        "note": "worst-case overhead by construction: host-dominated tiny "
+                "model, single-row requests; production dispatches "
+                "amortize the same per-dispatch cost over real device "
+                "time",
+    }
+    print(json.dumps(out))
+    res_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results")
+    try:
+        os.makedirs(res_dir, exist_ok=True)
+        with open(os.path.join(res_dir, "profiling_bench.json"), "w") as f:
+            json.dump(out, f, indent=2)
+    except OSError:
+        pass
+
+
 MEMORY_CASES = ("flagship_train_dispatch", "eval_suite",
                 "widest_scaling_shape")
 
@@ -2726,6 +2855,9 @@ def main():
         return
     if "--tracing" in sys.argv:
         bench_tracing()
+        return
+    if "--profiling" in sys.argv:
+        bench_profiling()
         return
     if "--precision" in sys.argv:
         bench_precision()

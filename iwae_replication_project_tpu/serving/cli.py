@@ -515,10 +515,20 @@ def _tier_mode(args, ops) -> int:
         # burn-rate gauges); per-replica engine histograms stay in the
         # shutdown snapshot (their unprefixed names would collide across
         # replicas on one exposition page). The tier's flight recorder
-        # additionally serves /traces as Chrome trace-event JSON.
+        # additionally serves /traces as Chrome trace-event JSON, the
+        # replica engines' dispatch profilers serve /prof, and /healthz
+        # reports tier liveness (503 once no healthy replica remains).
+        def _tier_health():
+            states = tier.router.replica_states()
+            healthy = sum(1 for s in states if s["healthy"])
+            return {"ok": healthy > 0, "replicas": len(states),
+                    "healthy": healthy,
+                    "outstanding": tier.router.outstanding}
+
         metrics_srv = start_metrics_server(
             (get_registry(), tier.registry), args.metrics_port,
-            recorder=tier.recorder)
+            recorder=tier.recorder, profilers=tier.router.profilers(),
+            health=_tier_health)
     info = tier.info()
     print(json.dumps({
         "tier": {"replicas": args.replicas,
@@ -793,9 +803,12 @@ def main(argv=None) -> int:
         from iwae_replication_project_tpu.telemetry import (
             get_registry, start_metrics_server)
         # engine registry (counters, per-bucket latency, serve/* spans) plus
-        # the process-default registry (aot/* dispatch spans)
+        # the process-default registry (aot/* dispatch spans); the engine's
+        # dispatch profiler backs /prof and /healthz reports bare liveness
         metrics_srv = start_metrics_server(
-            (get_registry(), eng.metrics.registry), args.metrics_port)
+            (get_registry(), eng.metrics.registry), args.metrics_port,
+            profilers=(eng.profiler,) if eng.profiler is not None else (),
+            health=lambda: {"ok": True, "mode": "engine", "ops": list(ops)})
     print(json.dumps({"warmup": warm,
                       "buckets": list(eng.ladder.buckets),
                       "k": eng.k,

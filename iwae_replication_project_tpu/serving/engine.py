@@ -159,7 +159,8 @@ class ServingEngine:
                  metrics: Optional[ServingMetrics] = None,
                  kernel_path: Optional[str] = None,
                  model: Optional[str] = None,
-                 precision: Optional[str] = None):
+                 precision: Optional[str] = None,
+                 profiling=None):
         import jax
 
         if isinstance(source, str):
@@ -250,6 +251,26 @@ class ServingEngine:
             max_batch = self.ladder.max_batch
         self.metrics = metrics or ServingMetrics(model=self.model,
                                                  precision=self.precision)
+        # the continuous profiling plane (telemetry/profiling.py): on by
+        # default — per-dispatch device-time attribution + drift detection
+        # on the completion thread, host-side metadata only (programs and
+        # results are identical with profiling off; bench.py --profiling
+        # stamps the measured overhead). ``profiling`` accepts a
+        # ProfilingConfig, True/None (defaults), or False (off).
+        self.profiler = None
+        if profiling is not False:
+            from iwae_replication_project_tpu.telemetry.profiling import (
+                DispatchProfiler, ProfilingConfig)
+            prof_cfg = profiling if isinstance(profiling, ProfilingConfig) \
+                else ProfilingConfig()
+            if prof_cfg.enabled:
+                self.profiler = DispatchProfiler(
+                    registry=self.metrics.registry, config=prof_cfg,
+                    label=self.store_label)
+        #: (op, k, bucket) -> static cost record | None — the profiler's
+        #: per-shape memo over the executable store's cost stamps (one
+        #: store scan per shape, not per dispatch)
+        self._prof_cost_cache: Dict[tuple, Optional[dict]] = {}
         self._clock = time.monotonic
         self._batcher = MicroBatcher(max_batch=max_batch,
                                      max_wait_us=max_wait_us,
@@ -718,6 +739,48 @@ class ServingEngine:
         failures) surface here."""
         return np.asarray(out)  # iwaelint: disable=host-sync -- the completion stage's designated fetch: blocking D2H is this thread's entire job; the dispatch hot path stays sync-free
 
+    def _prof_flops(self, op: str, k: int, rows: int) -> Optional[float]:
+        """Analytic matmul FLOPs of one dispatch's real rows (the measured-
+        MFU numerator, utils/flops.py — the same honest lower-bound
+        accounting every bench phase uses). Only ``score`` runs the
+        decoder stack the accounting models; other ops profile device time
+        without an MFU gauge."""
+        if op != "score":
+            return None
+        from iwae_replication_project_tpu.utils.flops import (
+            serving_score_flops_per_row)
+        return serving_score_flops_per_row(self.cfg, k) * rows
+
+    def _static_cost_for(self, op: str, k: int, bucket: int):
+        """This dispatch shape's static cost record from the executable
+        store (the compile-time ``iwae-cost`` stamp — the measured-vs-
+        static ceiling's denominator), memoized per shape. None when the
+        stamp was skipped/failed (the gauges then stay unpublished)."""
+        key = (op, k, bucket)
+        if key not in self._prof_cost_cache:
+            from iwae_replication_project_tpu.utils.compile_cache import (
+                executable_store)
+            cost = executable_store().cost_for(
+                self.store_label, self._aot_name(op),
+                self._build_key(op, k, bucket))
+            self._prof_cost_cache[key] = cost  # iwaelint: disable=unlocked-shared-state -- idempotent memo publish: the record is a pure function of the key; racing writers store the identical dict
+        return self._prof_cost_cache[key]
+
+    def _profile_dispatch(self, inf: _InFlight, now: float) -> None:
+        """Completion-stage profiling hook: attribute this batch's measured
+        device interval (enqueue -> fetched — the completion thread's own
+        clock reads, no extra sync) to its (model, program, bucket,
+        k-class) key. One profiler call per DISPATCH, not per request."""
+        t_disp = inf.batch[0].t_dispatch if inf.batch else None
+        if t_disp is None:
+            return
+        self.profiler.observe(
+            program=self._aot_name(inf.op), bucket=inf.bucket,
+            k_class=self._stamp_k(inf.op, inf.k), rows=len(inf.batch),
+            device_s=now - t_disp,
+            flops=self._prof_flops(inf.op, inf.k, len(inf.batch)),
+            cost=self._static_cost_for(inf.op, inf.k, inf.bucket))
+
     def _trace_attrs(self, op: str, k: int, bucket: int, n: int) -> dict:
         """Attrs stamped on a traced dispatch's ``engine/dispatch`` span
         (the mesh-sharded subclass adds its chunk/mesh shape here)."""
@@ -776,6 +839,8 @@ class ServingEngine:
             # evict this program again under budget pressure
             inf.pin.release()
         now = self._clock()
+        if self.profiler is not None:
+            self._profile_dispatch(inf, now)
         self._emit_trace_spans(inf, t_fetch0, now)
         for i, r in enumerate(inf.batch):
             self.metrics.record_latency(

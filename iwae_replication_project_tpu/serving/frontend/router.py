@@ -476,6 +476,15 @@ class ReplicaRouter:
         op names from minting burn-rate gauges."""
         return any(r.serves(op) for r in self._replicas)
 
+    def profilers(self) -> tuple:
+        """The live :class:`~...telemetry.profiling.DispatchProfiler` of
+        every replica engine that carries one (in-process engines do by
+        default; RemoteEngine proxies and fakes don't) — the ``/prof``
+        HTTP view's backing set. Lock-free: the replica list is
+        copy-on-write, same basis as :meth:`serves_op`."""
+        return tuple(p for p in (getattr(r.engine, "profiler", None)
+                                 for r in self._replicas) if p is not None)
+
     def replica_states(self) -> List[dict]:
         with self._lock:
             return [{"index": r.index, "healthy": r.healthy,

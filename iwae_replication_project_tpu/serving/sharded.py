@@ -165,6 +165,19 @@ class ShardedScoreEngine(ServingEngine):
         # not mint a metrics gauge per distinct k)
         return "dyn"
 
+    def _prof_flops(self, op: str, k: int, rows: int):
+        """The profiling plane's MFU numerator under DYNAMIC k: the
+        attribution key collapses every k into the bucket's one "dyn"
+        class (one program, one executable — see :meth:`_stamp_k`), but
+        the work is the request's actual k, so the FLOP count must use it
+        — the measured-MFU gauge then stays honest across a ragged k
+        stream instead of assuming the warmup k."""
+        if op != "score":
+            return None
+        from iwae_replication_project_tpu.utils.flops import (
+            serving_score_flops_per_row)
+        return serving_score_flops_per_row(self.cfg, k) * rows
+
     def _trace_attrs(self, op: str, k: int, bucket: int, n: int) -> dict:
         # a traced large-k dispatch's span carries the streaming shape (the
         # dynamic request k, the chunk it streams in, the mesh split) so a

@@ -16,6 +16,11 @@ is imported explicitly by the call sites that compute diagnostics):
   export;
 * :mod:`.slo` — :class:`SLOMonitor`: per-(model, op) latency/availability
   objectives published as multi-window burn-rate gauges;
+* :mod:`.profiling` — :class:`DispatchProfiler`: always-on per-dispatch
+  device-time attribution, measured MFU/bandwidth gauges against the AOT
+  registry's static roofline costs, and an EWMA drift detector emitting
+  typed ``prof/drift`` findings (the ``iwae-prof`` regression gate is
+  analysis/regress.py);
 * :mod:`.parity` — :func:`statistical_parity`: the toleranced acceptance
   gate low-precision (bf16/int8) serving legs must pass against the fp32
   oracle (pure-numpy, offline — check stages / bench legs / tests);
@@ -32,6 +37,12 @@ from iwae_replication_project_tpu.telemetry.parity import (
     DEFAULT_TOLERANCES,
     ParityTolerances,
     statistical_parity,
+)
+from iwae_replication_project_tpu.telemetry.profiling import (
+    DispatchProfiler,
+    DriftFinding,
+    ProfilingConfig,
+    detect_chip_peaks,
 )
 from iwae_replication_project_tpu.telemetry.registry import (
     Counter,
@@ -62,5 +73,7 @@ __all__ = [
     "prometheus_text", "start_metrics_server",
     "FlightRecorder", "TraceContext", "chrome_trace_events", "get_recorder",
     "SLOMonitor", "SLOObjective",
+    "DispatchProfiler", "DriftFinding", "ProfilingConfig",
+    "detect_chip_peaks",
     "DEFAULT_TOLERANCES", "ParityTolerances", "statistical_parity",
 ]

@@ -15,7 +15,9 @@ per-stage rows ride. This module adds the pull-based surface:
 * :func:`start_metrics_server` — a daemon-thread HTTP endpoint serving
   that page at ``/metrics`` — plus, when handed a flight recorder
   (telemetry/tracing.py), the retained request traces as Chrome
-  trace-event JSON at ``/traces`` — which the ``iwae-serve`` CLI exposes
+  trace-event JSON at ``/traces``; when handed dispatch profilers
+  (telemetry/profiling.py), their snapshots at ``/prof``; and tier
+  liveness at ``/healthz`` — all of which the ``iwae-serve`` CLI exposes
   via ``--metrics-port``.
 
 Dependency-free (stdlib http.server); the server snapshots the registry per
@@ -59,14 +61,32 @@ _HELP_PREFIXES = (
     ("telemetry/", "telemetry-pipeline self-accounting"),
     ("diag/", "on-device estimator diagnostics "
               "(telemetry/diagnostics.py)"),
+    ("prof/", "continuous profiling plane: per-dispatch device time, "
+              "measured MFU/bandwidth vs static roofline ceilings, and "
+              "EWMA drift accounting (telemetry/profiling.py)"),
 )
+
+
+def _escape_help(text: str) -> str:
+    """Escape a ``# HELP`` value per the exposition format: backslash and
+    newline (a raw newline would terminate the comment mid-value and turn
+    the remainder into a garbage sample line)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(value: str) -> str:
+    """Escape a label VALUE per the exposition format: backslash,
+    double-quote, newline — the three characters that would otherwise
+    terminate or corrupt the quoted string."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def _help_for(name: str, kind: str) -> str:
     for prefix, text in _HELP_PREFIXES:
         if name.startswith(prefix):
-            return text
-    return f"iwae {kind} {name!r}"
+            return _escape_help(text)
+    return _escape_help(f"iwae {kind} {name!r}")
 
 
 def _sanitize(name: str) -> str:
@@ -126,7 +146,8 @@ def prometheus_text(registries, namespace: str = "iwae") -> str:
             v = next((s[k] for k in (key, key + "_s") if s.get(k) is not None),
                      None)
             if v is not None:
-                lines.append(f'{m}{{quantile="{label}"}} {_fmt(v)}')
+                lines.append(
+                    f'{m}{{quantile="{_escape_label(label)}"}} {_fmt(v)}')
         count = s.get("count") or 0
         lines.append(f"{m}_count {_fmt(count)}")
         # _sum from the histogram's exact tracked total; the mean * count
@@ -146,11 +167,29 @@ def prometheus_text(registries, namespace: str = "iwae") -> str:
 class _MetricsHandler(BaseHTTPRequestHandler):
     registries: Sequence[MetricRegistry] = ()
     recorder = None     # optional FlightRecorder backing /traces
+    profilers: Sequence = ()   # optional DispatchProfilers backing /prof
+    health = None       # optional callable -> liveness dict backing /healthz
+
+    def _send_json(self, doc, status: int = 200) -> None:
+        import json
+
+        body = json.dumps(doc).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 (http.server API)
         path = self.path.split("?")[0]
         if path == "/traces":
             self._serve_traces()
+            return
+        if path == "/prof":
+            self._serve_prof()
+            return
+        if path == "/healthz":
+            self._serve_healthz()
             return
         if path not in ("/", "/metrics"):
             self.send_error(404)
@@ -170,17 +209,32 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         if self.recorder is None:
             self.send_error(404, "tracing is not enabled on this server")
             return
-        import json
-
         from iwae_replication_project_tpu.telemetry.tracing import (
             chrome_trace_events)
-        body = json.dumps(
-            chrome_trace_events(self.recorder.traces())).encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json; charset=utf-8")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        self._send_json(chrome_trace_events(self.recorder.traces()))
+
+    def _serve_prof(self):
+        """The profiling-plane snapshot(s) (telemetry/profiling.py): one
+        document per attached profiler — per-key measured/EWMA state, the
+        chip peaks in use, and the retained ``prof/drift`` findings."""
+        if not self.profilers:
+            self.send_error(404, "profiling is not enabled on this server")
+            return
+        self._send_json({"profilers": [p.snapshot() for p in self.profilers]})
+
+    def _serve_healthz(self):
+        """Tier liveness for the fleet controller and external probes:
+        200 + the liveness document when healthy, 503 when the provider
+        reports unhealthy OR raises (a dying tier must read as down, not
+        as a scrape error)."""
+        doc, ok = {"ok": True}, True
+        if self.health is not None:
+            try:
+                doc = dict(self.health())
+                ok = bool(doc.get("ok", True))
+            except Exception as e:
+                doc, ok = {"ok": False, "error": str(e)}, False
+        self._send_json(doc, status=200 if ok else 503)
 
     def log_message(self, *args):  # scrapes must not spam the serving stdout
         pass
@@ -197,12 +251,19 @@ class _MetricsServer(ThreadingHTTPServer):
 
 def start_metrics_server(registries, port: int,
                          host: str = "127.0.0.1",
-                         recorder=None) -> ThreadingHTTPServer:
+                         recorder=None, profilers=None,
+                         health=None) -> ThreadingHTTPServer:
     """Serve ``/metrics`` in a daemon thread; returns the live server
     (``.server_address[1]`` is the bound port — pass ``port=0`` for an
     ephemeral one; ``.shutdown()`` stops it and releases the port).
     ``recorder`` (a :class:`~.tracing.FlightRecorder`) additionally serves
-    its retained traces as Chrome trace-event JSON at ``/traces``."""
+    its retained traces as Chrome trace-event JSON at ``/traces``;
+    ``profilers`` (an iterable of :class:`~.profiling.DispatchProfiler`)
+    serves their merged snapshots at ``/prof``; ``health`` (a zero-arg
+    callable returning a liveness dict with an ``ok`` key) backs
+    ``/healthz`` — 200 when ok, 503 when not (or when the callable
+    raises). ``/healthz`` always answers: with no callable it reports
+    bare process liveness ``{"ok": true}``."""
     if isinstance(registries, MetricRegistry):
         registries = (registries,)
 
@@ -211,6 +272,10 @@ def start_metrics_server(registries, port: int,
 
     Handler.registries = tuple(registries)
     Handler.recorder = recorder
+    Handler.profilers = tuple(profilers) if profilers else ()
+    # staticmethod: a bare function set as a class attribute would bind as
+    # a method and receive the handler as a bogus first argument
+    Handler.health = staticmethod(health) if health is not None else None
     srv = _MetricsServer((host, port), Handler)
     threading.Thread(target=srv.serve_forever, name="iwae-metrics-http",
                      daemon=True).start()

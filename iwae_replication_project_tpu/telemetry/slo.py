@@ -34,7 +34,9 @@ unlabeled single-model tier):
   ``slo/<key>/availability_burn_<win>`` for every window (default ``5m``
   and ``1h``);
 * counters ``slo/<key>/requests``, ``slo/<key>/latency_violations``,
-  ``slo/<key>/errors``.
+  ``slo/<key>/errors``; plus the unkeyed ``slo/clock_regressions``
+  (injected-clock steps backwards are clamped to the high-water mark and
+  counted — windows never rewind and burns never go negative).
 
 Schema pinned in tests/test_telemetry.py.
 """
@@ -103,7 +105,10 @@ class _Ring:
         if self.epoch is None:
             self.epoch = e
             return
-        step = min(e - self.epoch, n)
+        # step clamped to [0, n]: a backwards clock (already clamped by the
+        # monitor, but this ring must be safe standalone) must not clear
+        # slots or move the epoch back — time only advances here
+        step = min(max(e - self.epoch, 0), n)
         for j in range(1, step + 1):
             i = (self.epoch + j) % n
             self.total[i] = self.bad_lat[i] = self.bad_err[i] = 0
@@ -155,6 +160,25 @@ class SLOMonitor:
         self._lock = threading.Lock()
         #: key -> [one _Ring per window]; guarded by _lock
         self._rings: Dict[str, list] = {}
+        #: high-water clock mark, guarded by _lock — see _now_clamped
+        self._last_now: Optional[float] = None
+
+    def _now_clamped(self) -> Tuple[float, bool]:
+        """Read the clock, clamped to its own high-water mark.
+
+        An injectable clock is not guaranteed monotonic (a wall-clock
+        passed by mistake, NTP step, or a test fixture rewinding): feeding
+        a backwards ``now`` into the rings would either resurrect stale
+        slots or mint negative burn windows.  Policy per the observability
+        plan: CLAMP to the last seen time and COUNT the regression —
+        never crash, never go back.  Caller must hold ``_lock``."""
+        now = self._clock()
+        regressed = self._last_now is not None and now < self._last_now
+        if regressed:
+            now = self._last_now
+        else:
+            self._last_now = now
+        return now, regressed
 
     @staticmethod
     def key_for(model: Optional[str], op: str) -> str:
@@ -175,8 +199,8 @@ class SLOMonitor:
         err_bad = error_code is not None and error_code in self.error_codes
         lat_bad = err_bad or latency_s > obj.latency_s
         key = self.key_for(model, op)
-        now = self._clock()
         with self._lock:
+            now, regressed = self._now_clamped()
             rings = self._rings.get(key)
             if rings is None:
                 rings = self._rings[key] = [
@@ -187,6 +211,8 @@ class SLOMonitor:
                 fracs.append(ring.fractions(now))
         # publish OUTSIDE the monitor lock: the registry has its own lock
         # and the lock graph stays a tree by construction
+        if regressed:
+            self.registry.counter("slo/clock_regressions").inc()
         for (_, label), (lat_frac, err_frac, _n) in zip(self.windows, fracs):
             self.registry.gauge(f"slo/{key}/latency_burn_{label}").set(
                 lat_frac / (1.0 - obj.latency_target))
@@ -201,8 +227,8 @@ class SLOMonitor:
     def snapshot(self) -> Dict[str, dict]:
         """Current burn rates per key (the wire/bench-facing document;
         schema pinned in tests/test_telemetry.py)."""
-        now = self._clock()
         with self._lock:
+            now, _ = self._now_clamped()
             keys = {key: [r.fractions(now) for r in rings]
                     for key, rings in self._rings.items()}
         out: Dict[str, dict] = {}

@@ -44,6 +44,10 @@ def build_argparser() -> argparse.ArgumentParser:
                     help="most recent N traces only")
     ap.add_argument("--trace-id", dest="trace_id", type=str, default=None,
                     help="one trace by id (e.g. from a latency exemplar)")
+    ap.add_argument("--json", action="store_true",
+                    help="wrap the output in the shared observability-CLI "
+                         "envelope (tool/schema/mode/ok/findings/data — "
+                         "same convention as iwae-prof --json)")
     return ap
 
 
@@ -71,12 +75,21 @@ def main(argv=None) -> int:
         return 2
     finally:
         cli.close()
+    n = len(doc.get("traceEvents", doc.get("traces", []))) \
+        if isinstance(doc, dict) else 0
+    if args.json:
+        # the one --json convention every observability CLI shares; the
+        # envelope maker lives with iwae-prof (analysis/regress.py) and
+        # the schema is pinned in tests/test_telemetry.py
+        from iwae_replication_project_tpu.analysis.regress import (
+            make_envelope)
+        mode = ("stats" if args.stats
+                else "raw" if args.raw else "chrome")
+        doc = make_envelope("iwae-trace", mode, ok=True, data=doc)
     text = json.dumps(doc, indent=2)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(text + "\n")
-        n = len(doc.get("traceEvents", doc.get("traces", []))) \
-            if isinstance(doc, dict) else 0
         print(f"iwae-trace: wrote {args.out} ({n} "
               f"{'events' if not args.raw else 'traces'})")
     else:

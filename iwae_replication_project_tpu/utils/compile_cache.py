@@ -692,6 +692,26 @@ class ExecutableStore:
             return [(key[1], key[2], key[3], e.cost)
                     for key, e in self._entries.items()]
 
+    def cost_for(self, model: Optional[str], name: str,
+                 build_key: Tuple) -> Optional[dict]:
+        """The static cost record of one ``(model, name, build_key)``
+        program (signature-agnostic: a program's cost is per build, and
+        the serving engines dispatch one signature per build key anyway).
+        Demoted entries answer too — eviction is a residency decision,
+        not a loss of the compile-time stamp, and the profiling plane's
+        static ceiling (telemetry/profiling.py) must not go blind when a
+        budget squeeze rotates a program to the cold tier.  None when no
+        entry exists or its stamp was skipped/failed."""
+        model = model if model is not None else DEFAULT_MODEL
+        with self._lock:
+            for key, e in self._entries.items():
+                if key[:3] == (model, name, build_key) and e.cost is not None:
+                    return e.cost
+            for key, cost in self._demoted.items():
+                if key[:3] == (model, name, build_key) and cost is not None:
+                    return cost
+        return None
+
     # -- donation gate -------------------------------------------------------
 
     def donation_allowed(self, requested: bool = True) -> bool:

@@ -73,7 +73,19 @@ machine-readable summary.
    >= 50 seeded perturbation schedules with a replica killed mid-burst:
    zero races, zero runtime leaks (open spans, store pins, undone
    futures), and results bitwise identical to an uninstrumented run;
-17. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
+17. **prof smoke** (scripts/prof_smoke.py) — the continuous profiling
+   plane on a real warm engine: profiling on/off bitwise identical, a
+   clean run forms the EWMA baseline with the measured-MFU gauge live
+   and zero drift, a 2x-slowdown fake clock trips a typed ``prof/drift``
+   finding naming the program, and ``/metrics`` + ``/prof`` +
+   ``/healthz`` serve it over HTTP;
+18. **perf gate** (``iwae-prof --diff``, analysis/regress.py) — the
+   statistical perf-regression gate: every committed
+   ``results/*_bench.json`` diffed against the committed
+   ``results/perf_baseline.json`` (paired medians + rank test + noise
+   floor from recorded spreads); a regressed artifact without a baseline
+   refresh fails the gate;
+19. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
    ``--sanitize`` armed.
 
 Every full-gate run writes ``results/check_summary.json`` (per-stage status,
@@ -277,6 +289,26 @@ def run_race_smoke() -> dict:
                                                   "race_smoke.py")])
 
 
+def run_prof_smoke() -> dict:
+    return run_step("prof smoke",
+                    [sys.executable, os.path.join("scripts",
+                                                  "prof_smoke.py")])
+
+
+def run_perf_gate() -> dict:
+    """The statistical perf-regression gate (analysis/regress.py): diff
+    every committed ``results/*_bench.json`` against the committed
+    baseline bundle. Exit 1 (a bench artifact regressed without a
+    baseline refresh via ``iwae-prof --collect``) fails the gate."""
+    import glob
+    artifacts = sorted(glob.glob(os.path.join(REPO, "results",
+                                              "*_bench.json")))
+    return run_step("perf gate", [
+        sys.executable, "-m", "iwae_replication_project_tpu.analysis.regress",
+        "--diff", os.path.join(REPO, "results", "perf_baseline.json"),
+    ] + artifacts)
+
+
 def run_tests(extra) -> dict:
     return run_step("tier-1 tests", [
         sys.executable, "-m", "pytest", "tests/", "-q", "-m", "not slow",
@@ -328,6 +360,8 @@ def main(argv=None) -> int:
         stages.append(run_precision_parity_smoke())
         stages.append(run_trace_smoke())
         stages.append(run_race_smoke())
+        stages.append(run_prof_smoke())
+        stages.append(run_perf_gate())
     if not args.lint_only:
         stages.append(run_tests(passthrough))
 

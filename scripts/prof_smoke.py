@@ -1,0 +1,185 @@
+"""Continuous-profiling smoke stage for scripts/check.py.
+
+One short CPU process proving the profiling plane's contracts on a REAL
+warm engine (telemetry/profiling.py + the engine's completion-stage
+hook), with the device interval pinned by an injected fetch-stage delay
+so the statistics are deterministic on shared CI hosts:
+
+1. **off-mode is invisible** — the identical request burst through a
+   ``profiling=False`` twin engine returns bitwise-identical results
+   (profiling is completion-thread metadata only; it never touches
+   seeds, payloads, or program shapes — ``bench.py --profiling`` owns
+   the overhead numbers);
+2. **a clean run does not drift** — a steady stream of identical
+   dispatches establishes the EWMA baseline and emits ZERO ``prof/drift``
+   findings, while the measured-MFU gauge goes live (explicit
+   ``ProfilingConfig`` peaks: CPU CI has no chip table entry — detection
+   stays honest, the smoke supplies the roofline);
+3. **a 2x slowdown trips the detector** — swapping the engine's
+   injectable clock for a 2x-scaled one (still monotonic; every
+   profiling timestamp reads the same clock) doubles every measured
+   interval: the very next dispatches cross the z-threshold and emit
+   typed ``prof/drift`` findings naming the program, with ratio ~2;
+4. **the HTTP surface serves it** — ``/metrics`` (correct Content-Type)
+   carries the ``iwae_prof_*`` MFU + drift families, ``/prof`` returns
+   the profiler snapshot JSON, and ``/healthz`` answers 200/ok.
+
+Exit 0 on success, 1 with a message on the first failed check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: fetch-stage injected device interval: large enough to dominate host
+#: jitter (the z-test's sigma floor then rules), small enough that the
+#: whole smoke stays ~1s of injected sleeps
+DELAY_S = 0.05
+
+
+def main() -> int:
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        setup_persistent_cache)
+
+    # warm-path discipline, like every entry point: repeated CI runs
+    # deserialize the serving programs instead of recompiling them
+    setup_persistent_cache(base_dir=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+    import numpy as np
+
+    from iwae_replication_project_tpu.models import iwae as model
+    from iwae_replication_project_tpu.serving import ServingEngine, faults
+    from iwae_replication_project_tpu.telemetry import (
+        ProfilingConfig, get_registry, start_metrics_server)
+
+    D = 32
+    cfg = model.ModelConfig(x_dim=D, n_hidden_enc=(16, 8), n_latent_enc=(8, 4),
+                            n_hidden_dec=(8, 16), n_latent_dec=(8, D))
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    x = (rng.rand(16, D) > 0.5).astype(np.float32)
+
+    prof_cfg = ProfilingConfig(
+        # explicit roofline peaks: arbitrary but fixed — the MFU gauge's
+        # liveness is what the smoke pins, not a real chip's number
+        peak_flops=1e12, peak_hbm_bytes=1e11,
+        warmup_samples=6, z_threshold=6.0, min_sigma_frac=0.1)
+
+    def engine(profiling):
+        # max_batch=1: every request is its own dispatch, so the profiled
+        # stream is N identical (program, bucket, k) intervals
+        return ServingEngine(params=params, model_config=cfg, k=4,
+                             max_batch=1, max_inflight=0, timeout_s=60.0,
+                             profiling=profiling)
+
+    # -- 1. off-mode parity: profiling is invisible in the bits -------------
+    eng_par = engine(prof_cfg)
+    assert eng_par.profiler is not None, "profiling did not default on"
+    eng_off = engine(False)
+    assert eng_off.profiler is None, "profiling=False still built a profiler"
+    eng_par.warmup(ops=("score",))
+    eng_off.warmup(ops=("score",))
+    out_on = eng_par.score(x)   # inline flush path: deterministic, no threads
+    out_off = eng_off.score(x)
+    assert out_on.tobytes() == out_off.tobytes(), \
+        "profiling on/off results are not bitwise identical"
+    eng_par.stop()
+    eng_off.stop()
+
+    # -- 2. clean run: baseline forms, MFU goes live, NO drift --------------
+    # pin the device interval with an injected fetch delay (inside the
+    # profiled [t_dispatch, fetched] window) BEFORE the drift engine's
+    # first dispatch, so every profiled interval — warmup included —
+    # shares the same ~50ms shape: sleep jitter is ~ms against that,
+    # far under the 10% sigma floor
+    faults.install(faults.FaultSchedule([faults.FaultRule(
+        site=faults.SITE_ENGINE_FETCH, times=10 ** 6, name="pin_device_s",
+        action=faults.delay(DELAY_S))]))
+    try:
+        eng = engine(prof_cfg)
+        eng.warmup(ops=("score",))
+        for i in range(12):
+            eng.score(x[i % len(x)])
+        snap = eng.profiler.snapshot()
+        assert snap["keys"], "clean run attributed no dispatches"
+        (key, st), = snap["keys"].items()
+        assert "serve_score" in key and st["count"] >= 12, (key, st)
+        assert st["last_mfu"] is not None and st["last_mfu"] > 0, \
+            f"measured MFU never published: {st}"
+        assert abs(st["ewma_s"] - DELAY_S) < DELAY_S, \
+            f"EWMA baseline implausible vs the injected interval: {st}"
+        assert not eng.profiler.findings(), \
+            f"clean run tripped drift: {eng.profiler.findings()[:2]}"
+
+        # -- 3. 2x-slowdown fake clock trips the drift detector -------------
+        # still monotonic (2*t now > t before), and every profiling
+        # timestamp reads the engine clock, so each measured interval
+        # exactly doubles: z = ewma / max(sigma, 0.1*ewma) >= 10 > 6
+        eng._clock = lambda: time.monotonic() * 2.0
+        for i in range(4):
+            eng.score(x[i])
+        findings = eng.profiler.findings()
+        assert findings, "2x-slowdown clock tripped no prof/drift finding"
+        f = findings[0]
+        assert f["kind"] == "prof/drift" and f["program"] == "serve_score", f
+        assert 1.5 < f["ratio"] < 2.6, \
+            f"drift ratio should be ~2x, got {f['ratio']:.2f}: {f}"
+        assert f["z"] > prof_cfg.z_threshold, f
+    finally:
+        faults.clear()
+
+    # -- 4. the HTTP surface: /metrics, /prof, /healthz ---------------------
+    srv = start_metrics_server(
+        (get_registry(), eng.metrics.registry), port=0,
+        profilers=(eng.profiler,),
+        health=lambda: {"ok": True, "engine": "running"})
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers.get("Content-Type") == \
+                "text/plain; version=0.0.4; charset=utf-8", \
+                r.headers.get("Content-Type")
+            page = r.read().decode()
+        for needle in ("iwae_prof_mfu_", "iwae_prof_drift_total",
+                       "iwae_prof_dispatches_total", "iwae_prof_device_s_"):
+            assert needle in page, f"/metrics missing {needle}"
+        with urllib.request.urlopen(f"{base}/prof", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers.get("Content-Type") == \
+                "application/json; charset=utf-8"
+            doc = json.loads(r.read().decode())
+        prof = doc["profilers"][0]
+        assert prof["keys"] and prof["findings"], prof
+        assert prof["findings"][0]["kind"] == "prof/drift"
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            assert r.status == 200
+            health = json.loads(r.read().decode())
+        assert health["ok"] is True and health["engine"] == "running", health
+    finally:
+        srv.shutdown()
+        eng.stop()
+
+    print(f"prof smoke OK: profiling on/off bitwise identical, "
+          f"{st['count']} clean dispatches -> MFU "
+          f"{st['last_mfu']:.3g} live + zero drift, 2x fake clock -> "
+          f"{len(findings)} prof/drift finding(s) on serve_score "
+          f"(ratio {f['ratio']:.2f}, z {f['z']:.1f}), "
+          f"/metrics + /prof + /healthz serving")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"prof smoke FAILED: {e}", file=sys.stderr)
+        sys.exit(1)

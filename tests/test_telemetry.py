@@ -1043,3 +1043,437 @@ class TestDriverIntegration:
         assert config_from_args([]).diagnostics is True
         assert config_from_args(["--no-diagnostics"]).diagnostics is False
         assert config_from_args(["--snr-window", "7"]).snr_window == 7
+
+
+# ---------------------------------------------------------------------------
+# continuous profiling plane: exposition escaping, HTTP endpoints, the
+# per-dispatch profiler, and the iwae-prof statistical regression gate
+# ---------------------------------------------------------------------------
+
+from iwae_replication_project_tpu.analysis import regress  # noqa: E402
+from iwae_replication_project_tpu.telemetry.exporters import (  # noqa: E402
+    _escape_help,
+    _escape_label,
+)
+from iwae_replication_project_tpu.telemetry.profiling import (  # noqa: E402
+    DispatchProfiler,
+    ProfilingConfig,
+)
+
+#: a value exercising every character the exposition format escapes
+_TORTURE = 'back\\slash "quote"\nnewline'
+
+
+def _prom_unescape(text):
+    """Reference decoder for the Prometheus exposition escapes: ``\\\\``,
+    ``\\n`` and (label values only) ``\\"`` — hand-rolled here so the
+    round-trip test does not share code with the encoder under test."""
+    out, i = [], 0
+    while i < len(text):
+        if text[i] == "\\" and i + 1 < len(text):
+            nxt = text[i + 1]
+            if nxt in ("\\", '"', "n"):
+                out.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+                i += 2
+                continue
+        out.append(text[i])
+        i += 1
+    return "".join(out)
+
+
+class TestPrometheusEscaping:
+    def test_help_escape_round_trips(self):
+        esc = _escape_help(_TORTURE)
+        assert "\n" not in esc                 # a raw newline would split
+        assert _prom_unescape(esc) == _TORTURE  # the comment line in two
+
+    def test_label_escape_round_trips(self):
+        esc = _escape_label(_TORTURE)
+        assert "\n" not in esc
+        # every double-quote survives only in escaped form
+        assert all(esc[i - 1] == "\\" for i, c in enumerate(esc) if c == '"')
+        assert _prom_unescape(esc) == _TORTURE
+
+    def test_page_help_survives_hostile_metric_name(self):
+        """A metric name carrying a backslash reaches the # HELP fallback
+        text (``iwae counter {name!r}``); the page form must unescape back
+        to exactly that text — pinned by parsing the page."""
+        reg = MetricRegistry()
+        name = "weird\\path/metric"
+        reg.counter(name).inc()
+        page = prometheus_text(reg).splitlines()
+        (help_ln,) = [ln for ln in page if ln.startswith("# HELP ")
+                      and "weird" in ln]
+        text = help_ln.split(" ", 3)[3]
+        assert _prom_unescape(text) == f"iwae counter {name!r}"
+        # the sample line itself uses the sanitized name, no backslash
+        assert any(ln.startswith("iwae_weird_path_metric_total ")
+                   for ln in page)
+
+    def test_quantile_labels_parse_back(self):
+        reg = MetricRegistry()
+        reg.histogram("h").record(0.01)
+        page = prometheus_text(reg)
+        import re as _re
+        labels = _re.findall(r'iwae_h\{quantile="((?:[^"\\]|\\.)*)"\}', page)
+        assert sorted(_prom_unescape(v) for v in labels) == \
+            ["0.5", "0.95", "0.99"]
+
+
+class TestMetricsEndpoints:
+    """Content types, /healthz liveness, and /prof (satellites)."""
+
+    def _get(self, port, path):
+        return urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10)
+
+    def test_content_types_pinned(self):
+        reg = MetricRegistry()
+        reg.counter("hits").inc()
+        srv = start_metrics_server(reg, port=0,
+                                   recorder=FlightRecorder(sample_every=1))
+        try:
+            port = srv.server_address[1]
+            resp = self._get(port, "/metrics")
+            assert resp.headers["Content-Type"] == \
+                "text/plain; version=0.0.4; charset=utf-8"
+            resp = self._get(port, "/traces")
+            assert resp.headers["Content-Type"] == \
+                "application/json; charset=utf-8"
+            assert "traceEvents" in json.loads(resp.read())
+        finally:
+            srv.shutdown()
+
+    def test_healthz_default_is_bare_liveness(self):
+        srv = start_metrics_server(MetricRegistry(), port=0)
+        try:
+            resp = self._get(srv.server_address[1], "/healthz")
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == \
+                "application/json; charset=utf-8"
+            assert json.loads(resp.read()) == {"ok": True}
+        finally:
+            srv.shutdown()
+
+    def test_healthz_reports_provider_document(self):
+        cell = [lambda: {"ok": True, "replicas": 2, "healthy": 2}]
+        srv = start_metrics_server(MetricRegistry(), port=0,
+                                   health=lambda: cell[0]())
+        try:
+            port = srv.server_address[1]
+            doc = json.loads(self._get(port, "/healthz").read())
+            assert doc == {"ok": True, "replicas": 2, "healthy": 2}
+            # unhealthy -> 503 with the document intact
+            cell[0] = lambda: {"ok": False, "replicas": 2, "healthy": 0}
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(port, "/healthz")
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read())["healthy"] == 0
+            # a RAISING provider reads as down, not as a scrape error
+            def boom():
+                raise RuntimeError("tier is dying")
+            cell[0] = boom
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(port, "/healthz")
+            assert ei.value.code == 503
+            doc = json.loads(ei.value.read())
+            assert doc["ok"] is False and "tier is dying" in doc["error"]
+        finally:
+            srv.shutdown()
+
+    def test_prof_endpoint_serves_snapshots(self):
+        reg = MetricRegistry()
+        p = DispatchProfiler(reg, ProfilingConfig(peak_flops=1e12,
+                                                  warmup_samples=2),
+                             label="m")
+        p.observe(program="serve_score", bucket=4, k_class="8", rows=4,
+                  device_s=0.004, flops=2e9)
+        srv = start_metrics_server(reg, port=0, profilers=(p,))
+        try:
+            port = srv.server_address[1]
+            resp = self._get(port, "/prof")
+            assert resp.headers["Content-Type"] == \
+                "application/json; charset=utf-8"
+            (doc,) = json.loads(resp.read())["profilers"]
+            assert "m/serve_score/b4/k8" in doc["keys"]
+        finally:
+            srv.shutdown()
+
+    def test_prof_endpoint_404_without_profilers(self):
+        srv = start_metrics_server(MetricRegistry(), port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(srv.server_address[1], "/prof")
+            assert ei.value.code == 404
+        finally:
+            srv.shutdown()
+
+
+class TestSLOClock:
+    """Burn-rate windows under a non-monotonic injected clock (satellite):
+    clamp to the high-water mark and count — never crash, never rewind a
+    window, never mint a negative burn."""
+
+    def test_backwards_clock_clamps_and_counts(self):
+        clock = [100.0]
+        reg = MetricRegistry()
+        mon = SLOMonitor(registry=reg, clock=lambda: clock[0])
+        mon.observe("score", 0.01)
+        clock[0] = 90.0                         # clock steps BACKWARDS
+        mon.observe("score", 0.01)
+        w = mon.snapshot()["score"]["windows"]["5m"]
+        assert w["requests"] == 2               # both observations counted
+        assert w["latency_burn"] >= 0.0
+        assert w["availability_burn"] >= 0.0
+        assert reg.counter("slo/clock_regressions").value >= 1
+        c_after = reg.counter("slo/clock_regressions").value
+        clock[0] = 103.0                        # forward progress resumes
+        mon.observe("score", 0.01)
+        assert reg.counter("slo/clock_regressions").value == c_after
+        assert mon.snapshot()["score"]["windows"]["5m"]["requests"] == 3
+
+    def test_snapshot_under_rewound_clock_never_negative(self):
+        clock = [1000.0]
+        mon = SLOMonitor(registry=MetricRegistry(), clock=lambda: clock[0])
+        mon.observe("score", 9.0)               # a latency violation
+        clock[0] = 0.0                          # massive rewind
+        snap = mon.snapshot()["score"]["windows"]
+        for w in snap.values():
+            assert w["requests"] == 1
+            assert w["latency_burn"] >= 0.0
+            assert w["availability_burn"] >= 0.0
+
+    def test_ring_advance_never_rewinds(self):
+        from iwae_replication_project_tpu.telemetry.slo import _Ring
+        r = _Ring(30.0, 3)
+        r.observe(100.0, True, False)
+        epoch = r.epoch
+        r._advance(0.0)                         # standalone safety clamp
+        assert r.epoch == epoch
+        assert sum(r.total) == 1 and sum(r.bad_lat) == 1
+
+
+class TestProfiler:
+    """DispatchProfiler: attribution keys, measured-vs-static gauges, EWMA
+    drift detection, clamped intervals (schema pins for /prof)."""
+
+    CFG = ProfilingConfig(peak_flops=1e12, peak_hbm_bytes=1e11,
+                          warmup_samples=4, min_sigma_frac=0.05)
+    COST = {"flops": 2e9, "bytes_accessed_fused": 1e8}
+
+    def test_mfu_bandwidth_and_ceiling_math(self):
+        reg = MetricRegistry()
+        p = DispatchProfiler(reg, self.CFG, label="mnist@bf16")
+        p.observe(program="serve_score", bucket=4, k_class="8", rows=4,
+                  device_s=0.004, flops=2e9, cost=self.COST)
+        key = "mnist@bf16/serve_score/b4/k8"
+        st = p.snapshot()["keys"][key]
+        # 2e9 FLOPs in 4ms = 5e11 FLOP/s over a 1e12 peak -> MFU 0.5
+        assert st["last_mfu"] == pytest.approx(0.5)
+        # 1e8 bytes in 4ms = 2.5e10 B/s over a 1e11 peak -> 0.25
+        assert st["last_hbm_frac"] == pytest.approx(0.25)
+        # roofline floor = max(2e9/1e12, 1e8/1e11) = 2ms; measured 4ms
+        assert st["last_ceiling_ratio"] == pytest.approx(2.0)
+        assert st["count"] == 1
+        # the same numbers ride the registry (the Prometheus surface)
+        assert reg.gauge(f"prof/mfu/{key}").value == pytest.approx(0.5)
+        assert reg.counter("prof/dispatches").value == 1
+        assert reg.counter("prof/rows").value == 4
+        page = prometheus_text(reg)
+        assert "iwae_prof_mfu_mnist_bf16_serve_score_b4_k8" in page
+        assert "iwae_prof_device_s_mnist_bf16_serve_score_b4_k8_count 1" \
+            in page
+
+    def test_drift_trips_once_then_converges(self):
+        reg = MetricRegistry()
+        p = DispatchProfiler(reg, self.CFG)
+        for _ in range(10):
+            assert p.observe(program="serve_score", bucket=4, k_class="8",
+                             rows=1, device_s=0.010) is None
+        assert p.findings() == []               # a steady stream is clean
+        f = p.observe(program="serve_score", bucket=4, k_class="8",
+                      rows=1, device_s=0.020)
+        assert f is not None
+        (doc,) = p.findings()
+        assert doc["kind"] == "prof/drift"
+        assert doc["program"] == "serve_score"
+        assert doc["bucket"] == 4 and doc["k_class"] == "8"
+        assert doc["ratio"] == pytest.approx(2.0, rel=1e-6)
+        assert doc["z"] > self.CFG.z_threshold
+        assert reg.counter("prof/drift").value == 1
+        # a PERSISTENT slowdown feeds the EWMA: the second slow sample is
+        # already within the adapting baseline, no alarm storm
+        p.observe(program="serve_score", bucket=4, k_class="8",
+                  rows=1, device_s=0.020)
+        assert len(p.findings()) == 1
+
+    def test_warmup_arms_detector(self):
+        p = DispatchProfiler(MetricRegistry(), self.CFG)
+        for _ in range(3):                      # below warmup_samples=4
+            p.observe(program="x", bucket=1, k_class="1", rows=1,
+                      device_s=0.001)
+        p.observe(program="x", bucket=1, k_class="1", rows=1,
+                  device_s=0.050)               # 50x, but still cold
+        assert p.findings() == []
+
+    def test_nonpositive_intervals_clamped_and_counted(self):
+        reg = MetricRegistry()
+        p = DispatchProfiler(reg, self.CFG)
+        assert p.observe(program="x", bucket=1, k_class="1", rows=1,
+                         device_s=0.0) is None
+        assert p.observe(program="x", bucket=1, k_class="1", rows=1,
+                         device_s=-1.0) is None
+        assert reg.counter("prof/clamped_intervals").value == 2
+        assert p.snapshot()["keys"] == {}       # never fed the baseline
+
+    def test_no_peaks_no_fabricated_gauges(self):
+        reg = MetricRegistry()
+        p = DispatchProfiler(reg, ProfilingConfig(warmup_samples=2),
+                             peaks={"peak_flops": None,
+                                    "peak_hbm_bytes": None, "source": "t"})
+        p.observe(program="x", bucket=1, k_class="1", rows=1,
+                  device_s=0.001, flops=1e9, cost=self.COST)
+        st = p.snapshot()["keys"]["x/b1/k1"]
+        assert st["last_mfu"] is None
+        assert st["last_hbm_frac"] is None
+        assert st["last_ceiling_ratio"] is None
+        page = prometheus_text(reg)
+        assert "iwae_prof_mfu_" not in page     # never a guessed peak
+        assert "iwae_prof_dispatches_total 1" in page
+
+    def test_snapshot_schema_pin(self):
+        p = DispatchProfiler(MetricRegistry(), self.CFG, label="m")
+        for d in (0.01, 0.01, 0.01, 0.01, 0.01, 0.1):
+            p.observe(program="x", bucket=1, k_class="1", rows=1,
+                      device_s=d)
+        snap = p.snapshot()
+        assert set(snap) == {"label", "peaks", "config", "keys",
+                             "findings", "dropped_findings"}
+        assert snap["label"] == "m"
+        assert set(snap["config"]) == {"ewma_alpha", "z_threshold",
+                                       "warmup_samples"}
+        (st,) = snap["keys"].values()
+        assert set(st) == {"count", "ewma_s", "sigma_s", "last_s",
+                           "last_mfu", "last_hbm_frac",
+                           "last_ceiling_ratio", "last_z"}
+        (finding,) = snap["findings"]
+        assert set(finding) == {"kind", "key", "program", "model", "bucket",
+                                "k_class", "measured_s", "baseline_s",
+                                "sigma_s", "z", "ratio", "seq"}
+        json.dumps(snap)                        # wire-safe by construction
+
+
+class TestRegress:
+    """iwae-prof: direction heuristic, rank test, and the end-to-end gate
+    (exit codes + the shared --json envelope, schema pinned here)."""
+
+    OLD = {"wall_s": 1.0, "rows_per_sec": 1000.0,
+           "pairs": {"pairs_s": [0.100, 0.101, 0.099, 0.102, 0.098]}}
+
+    def test_direction_heuristic(self):
+        assert regress.direction_for("a/rows_per_sec") == 1
+        assert regress.direction_for("x/speedup") == 1
+        assert regress.direction_for("wall_s") == -1
+        assert regress.direction_for("overhead_pct_best") == -1
+        assert regress.direction_for("score/latency_p99_s") == -1
+        # polarity lives in the LEAF name only: a directional parent does
+        # not rescue an opaque leaf
+        assert regress.direction_for("latency/p99") == 0
+        assert regress.direction_for("off_over_on_pairs") == 0
+        assert regress.direction_for("n_devices") == 0
+
+    def test_rank_sum_p(self):
+        same = [1.0, 2.0, 3.0, 4.0, 5.0]
+        assert regress.rank_sum_p(same, list(same)) == pytest.approx(1.0)
+        assert regress.rank_sum_p([], [1.0]) == 1.0
+        a = [1.00, 1.01, 0.99, 1.02, 0.98]
+        b = [2.00, 2.01, 1.99, 2.02, 1.98]
+        assert regress.rank_sum_p(a, b) < 0.05
+
+    def test_extract_metrics_paths(self):
+        m = regress.extract_metrics(
+            {"wall_s": 1.5, "flag": True, "pairs_s": [0.1, 0.2],
+             "nested": {"x": 2}, "rows": [{"y": 3}, {"y": 4}]})
+        assert m == {"wall_s": [1.5], "pairs_s": [0.1, 0.2],
+                     "nested/x": [2.0], "rows[0]/y": [3.0],
+                     "rows[1]/y": [4.0]}      # bools are config, skipped
+
+    def _write(self, tmp_path, name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_diff_flags_2x_slowdown_and_names_the_metric(self, tmp_path,
+                                                         capsys):
+        new = {"wall_s": 2.0, "rows_per_sec": 400.0,
+               "pairs": {"pairs_s": [v * 2 for v in
+                                     self.OLD["pairs"]["pairs_s"]]}}
+        old_p = self._write(tmp_path, "old.json", self.OLD)
+        new_p = self._write(tmp_path, "bench.json", new)
+        assert regress.main(["--diff", old_p, new_p]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSION bench:pairs/pairs_s" in out
+        assert "REGRESSION bench:wall_s" in out
+        # the --json form carries the same findings in the envelope
+        assert regress.main(["--diff", old_p, new_p, "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert set(doc) == {"tool", "schema", "mode", "ok", "findings",
+                            "data"}
+        assert doc["tool"] == "iwae-prof"
+        assert doc["schema"] == regress.ENVELOPE_SCHEMA
+        assert doc["mode"] == "diff" and doc["ok"] is False
+        keys = {(f["artifact"], f["key"]) for f in doc["findings"]}
+        assert ("bench", "pairs/pairs_s") in keys
+        for f in doc["findings"]:
+            assert f["kind"] == "perf/regression"
+            assert f["rel_change"] > 0 or f["key"] == "rows_per_sec"
+
+    def test_self_diff_and_collected_baseline_pass(self, tmp_path, capsys):
+        a = self._write(tmp_path, "a_bench.json", self.OLD)
+        baseline = str(tmp_path / "baseline.json")
+        assert regress.main(["--collect", a, "--out", baseline]) == 0
+        doc = json.loads(open(baseline).read())
+        assert doc["kind"] == regress.BASELINE_KIND
+        assert set(doc["artifacts"]) == {"a_bench"}
+        assert regress.main(["--diff", baseline, a]) == 0
+        assert "0 regression(s)" in capsys.readouterr().out
+
+    def test_within_noise_shift_not_flagged(self, tmp_path):
+        # the recorded spread's rel-IQR is ~20%: a 5% median shift in the
+        # bad direction must NOT gate
+        new = {"wall_s": 1.04,       # scalar: under the 10% scalar floor
+               "rows_per_sec": 980.0,
+               "pairs": {"pairs_s": [v * 1.05 for v in
+                                     [0.10, 0.11, 0.09, 0.12, 0.08]]}}
+        old = {"wall_s": 1.0, "rows_per_sec": 1000.0,
+               "pairs": {"pairs_s": [0.10, 0.11, 0.09, 0.12, 0.08]}}
+        old_p = self._write(tmp_path, "old.json", old)
+        new_p = self._write(tmp_path, "bench.json", new)
+        assert regress.main(["--diff", old_p, new_p]) == 0
+
+    def test_missing_file_is_usage_error(self, tmp_path):
+        assert regress.main(
+            ["--diff", str(tmp_path / "nope.json"),
+             str(tmp_path / "also_nope.json")]) == 2
+
+    def test_trace_cli_shares_the_envelope(self, capsys):
+        """iwae-trace --json and iwae-prof --json emit ONE convention
+        (satellite): same keys, same schema version."""
+        from iwae_replication_project_tpu.serving.frontend import ServingTier
+        from iwae_replication_project_tpu.telemetry import trace_cli
+        t = ServingTier([_TraceFakeEngine()], port=0,
+                        recorder=FlightRecorder(sample_every=1))
+        t.start()
+        try:
+            rc = trace_cli.main([f"127.0.0.1:{t.port}", "--stats", "--json"])
+        finally:
+            t.stop(timeout_s=10)
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert set(doc) == {"tool", "schema", "mode", "ok", "findings",
+                            "data"}
+        assert doc["tool"] == "iwae-trace"
+        assert doc["schema"] == regress.ENVELOPE_SCHEMA
+        assert doc["mode"] == "stats" and doc["ok"] is True
+        assert doc["findings"] == []
+        assert "retained" in doc["data"]
