@@ -82,6 +82,93 @@ def online_logsumexp_finalize(state: OnlineLSE, mean: bool = False) -> jax.Array
     return out
 
 
+class OnlineLSEVar(NamedTuple):
+    """Augmented streaming-logsumexp carry: first AND second weight moments.
+
+    Extends :class:`OnlineLSE` with ``s2 = sum(exp(2*(x - m)))`` — the
+    second moment of the weights under the same running max — which is what
+    a per-row standard-error / effective-sample-size estimate needs without
+    ever materializing the weights:
+
+    * ``ESS = s^2 / s2`` (Kong's effective sample size, in [1, n]);
+    * ``SE[log p_hat] ~= sqrt((s2/s^2 - 1/n) * n/(n-1))`` (delta method on
+      ``log mean(w)``; both ratios are scale-free, so the running max
+      cancels exactly).
+
+    The ``(m, s)`` arithmetic is kept expression-identical to
+    :class:`OnlineLSE`'s update/merge, so a consumer that needs bitwise
+    parity with the plain carry (the adaptive scorer's early-stopped-prefix
+    contract) gets it by construction. Merging is associative, so the same
+    state works for a scan over chunks and a psum over devices.
+    """
+
+    m: jax.Array
+    s: jax.Array
+    s2: jax.Array
+    n: jax.Array
+
+
+def online_lse_var_init(shape, dtype=jnp.float32) -> OnlineLSEVar:
+    return OnlineLSEVar(
+        m=jnp.full(shape, -jnp.inf, dtype=dtype),
+        s=jnp.zeros(shape, dtype=dtype),
+        s2=jnp.zeros(shape, dtype=dtype),
+        n=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def online_lse_var_update(state: OnlineLSEVar, log_w: jax.Array,
+                          axis: int = 0) -> OnlineLSEVar:
+    """Fold a chunk of log-weights into the augmented state. ``(m, s)``
+    follow :func:`online_logsumexp_update` bit-for-bit; ``s2`` rescales by
+    ``exp(2*(m_old - m_new))`` (squared-weight units)."""
+    chunk_m = jnp.max(log_w, axis=axis)
+    new_m = jnp.maximum(state.m, chunk_m)
+    safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    scaled_old = state.s * jnp.exp(state.m - safe_m)
+    chunk_s = jnp.sum(jnp.exp(log_w - jnp.expand_dims(safe_m, axis)), axis=axis)
+    scaled_old2 = state.s2 * jnp.exp(2.0 * (state.m - safe_m))
+    chunk_s2 = jnp.sum(jnp.exp(2.0 * (log_w - jnp.expand_dims(safe_m, axis))),
+                       axis=axis)
+    return OnlineLSEVar(m=new_m, s=scaled_old + chunk_s,
+                        s2=scaled_old2 + chunk_s2,
+                        n=state.n + jnp.int32(log_w.shape[axis]))
+
+
+def online_lse_var_merge(a: OnlineLSEVar, b: OnlineLSEVar) -> OnlineLSEVar:
+    """Associative merge of two augmented partial states."""
+    new_m = jnp.maximum(a.m, b.m)
+    safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    return OnlineLSEVar(
+        m=new_m,
+        s=a.s * jnp.exp(a.m - safe_m) + b.s * jnp.exp(b.m - safe_m),
+        s2=a.s2 * jnp.exp(2.0 * (a.m - safe_m))
+        + b.s2 * jnp.exp(2.0 * (b.m - safe_m)),
+        n=a.n + b.n,
+    )
+
+
+def lse_var_stats(s: jax.Array, s2: jax.Array, n) -> tuple:
+    """``(ess, se)`` from merged augmented-carry sums (scale-free: callers
+    pass the max-subtracted ``s``/``s2`` directly; the running max cancels).
+
+    ``ess = s^2/s2`` (1 when one weight dominates, n for uniform weights);
+    ``se`` is the delta-method standard error of ``log mean(w)`` with the
+    n/(n-1) small-sample correction. An all-``-inf`` row (``s == 0``) gets
+    ``ess = 0`` and ``se = +inf`` — defined, never NaN, and never falsely
+    converged.
+    """
+    n_f = jnp.asarray(n, s.dtype)
+    safe_s = jnp.where(s > 0, s, 1.0)
+    safe_s2 = jnp.where(s2 > 0, s2, 1.0)
+    ess = jnp.where(s > 0, safe_s * safe_s / safe_s2, 0.0)
+    bessel = n_f / jnp.maximum(n_f - 1.0, 1.0)
+    var = jnp.maximum(safe_s2 / (safe_s * safe_s) - 1.0 / jnp.maximum(n_f, 1.0),
+                      0.0) * bessel
+    se = jnp.where(s > 0, jnp.sqrt(var), jnp.inf)
+    return ess, se
+
+
 def streaming_logmeanexp(log_w_fn, k: int, chunk: int, shape, dtype=jnp.float32) -> jax.Array:
     """``logmeanexp`` over k samples produced chunk-at-a-time by `log_w_fn(i)`.
 

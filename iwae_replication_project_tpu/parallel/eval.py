@@ -38,8 +38,11 @@ from iwae_replication_project_tpu.evaluation.metrics import (
 from iwae_replication_project_tpu.models import iwae as model
 from iwae_replication_project_tpu.ops import distributions as dist
 from iwae_replication_project_tpu.ops.logsumexp import (
+    lse_var_stats,
     online_logsumexp_init,
     online_logsumexp_update,
+    online_lse_var_init,
+    online_lse_var_update,
 )
 from iwae_replication_project_tpu.parallel.dp import (
     _fold_axis_coords,
@@ -54,6 +57,21 @@ def _merge_lse_over_sp(state):
     safe = jnp.where(jnp.isfinite(m_g), m_g, 0.0)
     s_g = lax.psum(state.s * jnp.exp(state.m - safe), AXES.sp)
     return m_g, safe, s_g
+
+
+def _merge_lse_var_over_sp(state):
+    """Cross-device merge of the AUGMENTED carry (ops.logsumexp.OnlineLSEVar):
+    one pmax + one psum. ``s`` uses the exact :func:`_merge_lse_over_sp`
+    expression (the adaptive scorer's bitwise fixed-k-prefix contract rides
+    on it); ``s2`` rescales by the squared max shift. The two sums ride one
+    stacked psum so the per-round collective cost of the adaptive
+    convergence check stays one pmax + one psum, like the plain merge."""
+    m_g = lax.pmax(state.m, AXES.sp)
+    safe = jnp.where(jnp.isfinite(m_g), m_g, 0.0)
+    scale = jnp.exp(state.m - safe)
+    both = lax.psum(jnp.stack([state.s * scale, state.s2 * scale * scale]),
+                    AXES.sp)
+    return m_g, safe, both[0], both[1]
 
 
 # --- shared per-device bodies -------------------------------------------------
@@ -116,6 +134,142 @@ def _local_row_streaming_log_px(params, cfg, base_key, seeds_local, x_local,
 
     init = online_logsumexp_init((x_local.shape[0],))
     return lax.fori_loop(0, blocks_per_dev, body, init)
+
+
+def _local_row_adaptive_log_px(params, cfg, base_key, seeds_local, x_local,
+                               k_cap, target_se, ess_floor,
+                               k_chunk: int, n_sp: int):
+    """Per-device body of the accuracy-targeted adaptive scorer:
+    ``[B_local, 3]`` rows of ``(log p_hat, achieved_se, k_used)``.
+
+    Two phases, one sample stream (block ``g`` of a row always draws from
+    ``fold_in(fold_in(base_key, seed_row), g)`` — the PR-9 stream):
+
+    **Phase 1 — decide k_used.** Devices walk the stream round-robin
+    (round ``r`` covers global blocks ``r*sp + sp_idx``), folding blocks
+    into the augmented carry (ops.logsumexp.OnlineLSEVar). After each round
+    the per-device carries merge across sp (one pmax + one stacked psum)
+    and every row's running ESS / delta-method SE is checked against the
+    target; a row converges at the first round whose PREFIX of the stream
+    meets it, freezing ``k_used`` at that prefix length. The loop exits
+    when every row has converged or the cap is reached (rows that never
+    converge get ``k_used = k_cap``). ``k_used`` is therefore a pure
+    function of (weights, payload, seed, target, caps) plus the program
+    constants (k_chunk, sp) — the stopping grid is quantized to
+    ``sp * k_chunk`` samples per round; it cannot depend on routing,
+    coalescing, batch peers (per-row RNG), or on whether the row would
+    have kept going.
+
+    **Phase 2 — recompute the answer at k_used, on the fixed-k schedule.**
+    The returned bits must equal a fixed-k call at ``k = k_used``
+    (early-stopped prefix == fixed-k prefix, test-pinned), and the fixed
+    path assigns block ranges ``[sp_idx*bpd, (sp_idx+1)*bpd)`` with
+    ``bpd = ceil(ceil(k/k_chunk)/sp)`` — a *k-dependent* layout phase 1's
+    round-robin walk cannot reproduce. So the answer is recomputed over
+    the ``k_used``-prefix with exactly the fixed-k per-device schedule
+    (per-row ``bpd``, identical masking and carry arithmetic), making the
+    equality hold by construction. The cost is bounded by one extra pass
+    over the kept prefix — for easy rows still a fraction of the fixed
+    k_cap cost (bench.py --adaptive-k quantifies both passes honestly).
+    """
+    sp_idx = lax.axis_index(AXES.sp)
+    n_rows = x_local.shape[0]
+
+    def row_block(seed, xr, g):
+        key = jax.random.fold_in(jax.random.fold_in(base_key, seed), g)
+        return model.log_weights(params, cfg, key, xr[None], k_chunk)[:, 0]
+
+    # -- phase 1: round-robin stream until every row's prefix meets target --
+    n_blocks_cap = lax.div(k_cap + (k_chunk - 1), k_chunk)
+    rounds_cap = lax.div(n_blocks_cap + (n_sp - 1), n_sp)
+    round_samples = n_sp * k_chunk
+
+    def p1_cond(carry):
+        _, converged, _, r = carry
+        return jnp.logical_and(r < rounds_cap,
+                               jnp.logical_not(jnp.all(converged)))
+
+    def p1_body(carry):
+        st, converged, k_used, r = carry
+        g = r * n_sp + sp_idx
+        lw = jax.vmap(lambda s, xr: row_block(s, xr, g))(
+            seeds_local, x_local)                        # [B_local, k_chunk]
+        sample_idx = g * k_chunk + jnp.arange(k_chunk)
+        lw = jnp.where(sample_idx[None, :] < k_cap, lw, -jnp.inf)
+        st = online_lse_var_update(st, lw, axis=1)
+        _, _, s_g, s2_g = _merge_lse_var_over_sp(st)
+        n_drawn = jnp.minimum((r + 1) * round_samples, k_cap)
+        ess, se = lse_var_stats(s_g, s2_g, n_drawn)
+        ok = jnp.logical_or(
+            jnp.logical_and(target_se > 0, se <= target_se),
+            jnp.logical_and(ess_floor > 0, ess >= ess_floor))
+        k_used = jnp.where(jnp.logical_and(ok, jnp.logical_not(converged)),
+                           n_drawn, k_used)
+        return st, jnp.logical_or(converged, ok), k_used, r + 1
+
+    init = (online_lse_var_init((n_rows,)),
+            jnp.zeros((n_rows,), bool),
+            jnp.broadcast_to(k_cap, (n_rows,)),
+            jnp.int32(0))
+    _, _, k_used, _ = lax.while_loop(p1_cond, p1_body, init)
+
+    # -- phase 2: fixed-k schedule over each row's k_used-prefix -----------
+    n_blocks_row = lax.div(k_used + (k_chunk - 1), k_chunk)       # [B_local]
+    bpd_row = lax.div(n_blocks_row + (n_sp - 1), n_sp)            # [B_local]
+
+    def p2_body(i, st):
+        g_row = sp_idx * bpd_row + i                              # [B_local]
+        lw = jax.vmap(lambda s, xr, g: row_block(s, xr, g))(
+            seeds_local, x_local, g_row)                 # [B_local, k_chunk]
+        sample_idx = g_row[:, None] * k_chunk + jnp.arange(k_chunk)[None, :]
+        # beyond a row's own bpd the block index would wrap into another
+        # device's range: mask the whole block (exact identity update)
+        valid = jnp.logical_and(sample_idx < k_used[:, None],
+                                (i < bpd_row)[:, None])
+        lw = jnp.where(valid, lw, -jnp.inf)
+        return online_lse_var_update(st, lw, axis=1)
+
+    st2 = lax.fori_loop(0, jnp.max(bpd_row), p2_body,
+                        online_lse_var_init((n_rows,)))
+    # final merge: (m, s) through the exact fixed-path expression (the
+    # bitwise contract), s2 as its own psum beside it
+    m_g, safe, s_g = _merge_lse_over_sp(st2)
+    s2_g = lax.psum(st2.s2 * jnp.exp(2.0 * (st2.m - safe)), AXES.sp)
+    log_px = jnp.log(s_g) + safe - jnp.log(k_used.astype(jnp.float32))
+    _, se = lse_var_stats(s_g, s2_g, k_used)
+    return jnp.stack([log_px, se, k_used.astype(jnp.float32)], axis=1)
+
+
+def sharded_score_adaptive_offline(params, cfg, mesh, base_key, seeds, x, *,
+                                   k_cap: int, target_se: float = 0.0,
+                                   ess_floor: float = 0.0,
+                                   k_chunk: int = 250):
+    """Offline entry to THE adaptive serving score program: ``[B, 3]`` rows
+    of ``(log p_hat, achieved_se, k_used)`` — the adaptive sibling of
+    :func:`sharded_score_offline`, calling the exact jitted program the
+    serving engine dispatches (serving/programs.make_sharded_score_adaptive)
+    so offline sweeps and online ``score_adaptive`` requests at the same
+    (mesh, k_chunk, seed, target) are bitwise identical by construction.
+
+    ``target_se`` / ``ess_floor`` <= 0 disable that criterion (both ride as
+    dynamic scalars; a disabled pair degenerates to fixed ``k = k_cap``
+    scoring with SE reporting).
+    """
+    from iwae_replication_project_tpu.serving.programs import (
+        make_sharded_score_adaptive)
+
+    seeds = jnp.asarray(seeds, jnp.int32)
+    x = jnp.asarray(x, jnp.float32)
+    n = x.shape[0]
+    n_dp = mesh.shape[AXES.dp]
+    pad = (-n) % n_dp
+    if pad:
+        seeds = jnp.pad(seeds, (0, pad))
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    fn = make_sharded_score_adaptive(cfg, mesh, k_chunk)
+    out = fn(params, base_key, seeds, x, jnp.int32(k_cap),
+             jnp.float32(target_se), jnp.float32(ess_floor))
+    return out[:n]
 
 
 def sharded_score_offline(params, cfg, mesh, base_key, seeds, x, k: int,

@@ -86,10 +86,23 @@ class Request:
     #: pipeline-stage spans attach under (None = untraced; the engine's
     #: hot path then records nothing)
     trace: Optional[Any] = None
+    #: adaptive accuracy target (``score_adaptive`` only; 0.0 = criterion
+    #: disabled). For adaptive requests ``k`` above holds ``k_cap``. These
+    #: join the coalescing group: every request in one dispatch shares ONE
+    #: set of target scalars (they ride the program as dynamic replicated
+    #: inputs), so only exact-target peers may batch together.
+    target_se: float = 0.0
+    ess_floor: float = 0.0
 
     @property
-    def group(self) -> Tuple[str, int]:
-        return (self.op, self.k)
+    def group(self) -> Tuple:
+        """The coalescing key: only same-program, same-dynamic-scalar peers
+        may share a dispatch. Non-adaptive requests keep the historical
+        ``(op, k)`` key; adaptive requests extend it with their exact
+        target pair."""
+        if self.target_se == 0.0 and self.ess_floor == 0.0:
+            return (self.op, self.k)
+        return (self.op, self.k, self.target_se, self.ess_floor)
 
 
 class MicroBatcher:

@@ -178,6 +178,67 @@ def validate_model(model, served) -> str:
     return model
 
 
+def validate_adaptive_target(target_se, ess_floor, k_cap,
+                             k_max: int) -> Tuple[float, float, int]:
+    """Shared adaptive-target check for ``score_adaptive`` requests:
+    ``(target_se, ess_floor, k_cap)`` normalized, or ValueError (the typed
+    ``bad_request``).
+
+    One implementation for every admission boundary — engine submit,
+    replica router, wire protocol — so a malformed accuracy target means
+    the same thing everywhere and surfaces as a typed ``bad_request``
+    RESPONSE at the first boundary it crosses (the connection survives),
+    never an internal error inside a replica.
+
+    Rules: ``k_cap`` is a k (``validate_k`` against ``k_max``);
+    ``target_se`` and ``ess_floor`` are finite positive reals when given
+    (``None`` -> disabled, normalized to 0.0 — the dynamic-scalar encoding
+    the program takes); at least one of the two criteria must be active
+    (a target-less adaptive request is a fixed-k request wearing the wrong
+    op); an ``ess_floor`` above ``k_cap`` can never be met (ESS <= n) and
+    is rejected rather than silently truncated to the cap.
+    """
+    k_cap = validate_k(k_cap, k_max)
+
+    def norm(name, v):
+        if v is None:
+            return 0.0
+        if isinstance(v, bool) or not isinstance(v, (int, float, np.floating,
+                                                     np.integer)):
+            raise ValueError(f"{name} must be a number, got "
+                             f"{type(v).__name__}")
+        v = float(v)
+        if not np.isfinite(v) or v <= 0.0:
+            raise ValueError(f"{name} must be finite and > 0, got {v!r}")
+        return v
+
+    target_se = norm("target_se", target_se)
+    ess_floor = norm("ess_floor", ess_floor)
+    if target_se == 0.0 and ess_floor == 0.0:
+        raise ValueError("an adaptive score request needs a target: give "
+                         "target_se > 0 and/or ess_floor > 0 (use the plain "
+                         "score op for fixed-k scoring)")
+    if ess_floor > k_cap:
+        raise ValueError(f"ess_floor={ess_floor:g} can never be reached "
+                         f"under k_cap={k_cap} (ESS <= sample count)")
+    return target_se, ess_floor, k_cap
+
+
+def target_class(target_se: float, ess_floor: float) -> str:
+    """The coarse target-class label an adaptive request's measured
+    ``k_used`` is attributed under (router EWMA, profiler): the active
+    criterion plus its decade, e.g. ``"se:e-2"`` or ``"ess:e+2"``. Decade
+    quantization keeps the class set small under ragged target streams
+    while still separating cheap asks from expensive ones — exact values
+    stay in the request (and in the dispatch scalars); the class is an
+    ACCOUNTING key only, never a program key.
+    """
+    import math
+    if target_se > 0.0:
+        return f"se:e{math.floor(math.log10(target_se)):+d}"
+    return f"ess:e{math.floor(math.log10(max(ess_floor, 1.0))):+d}"
+
+
 def validate_k(k, k_max: int) -> int:
     """Shared out-of-range-k check: an int in ``[1, k_max]`` or ValueError.
 

@@ -225,6 +225,18 @@ def build_argparser() -> argparse.ArgumentParser:
                            "per-request k through these values (e.g. "
                            "'50,500,5000') — the closed-loop driver for "
                            "the large-k path; reports per-k latency")
+    tier.add_argument("--target-se", dest="target_se", type=float,
+                      default=None,
+                      help="client mode: drive score_adaptive instead of "
+                           "score — per-row target standard error on "
+                           "log p-hat(x); with --k-sweep the values become "
+                           "sample CAPS and the sweep reports measured "
+                           "k_used next to latency")
+    tier.add_argument("--ess-floor", dest="ess_floor", type=float,
+                      default=None,
+                      help="client mode: adaptive ESS stopping floor "
+                           "(combinable with --target-se; at least one "
+                           "required for score_adaptive)")
     scale = ap.add_argument_group(
         "elastic fleet (serving/fleet/; needs --replicas)")
     scale.add_argument("--autoscale", action="store_true",
@@ -573,8 +585,18 @@ def _client_interactive(cli) -> None:
             continue
         try:
             req = json.loads(line)
-            rid = cli.submit(req.get("op", "score"), req["x"],
-                             k=req.get("k"), seed=req.get("seed"))
+            op = req.get("op", "score")
+            if op in ("submit_job", "job_status"):
+                # bulk-lane job ops answer synchronously like control ops
+                doc = cli._control(op, **{key: req[key] for key in req
+                                          if key not in ("op", "id")})
+                print(json.dumps({"id": req.get("id"), "ok": True,
+                                  "result": doc}), flush=True)
+                continue
+            rid = cli.submit(op, req["x"], k=req.get("k"),
+                             seed=req.get("seed"),
+                             target_se=req.get("target_se"),
+                             ess_floor=req.get("ess_floor"))
             resp = cli.drain([rid])[rid]
             # the caller correlates on ITS id, not the client's wire id
             resp["id"] = req.get("id")
@@ -596,16 +618,24 @@ def _client_k_sweep(cli, args) -> int:
         TierError)
 
     info = cli.info()
-    if "score" not in info["row_dims"]:
-        print(json.dumps({"error": "tier does not serve 'score'"}),
+    want_op = "score_adaptive" \
+        if (args.target_se is not None or args.ess_floor is not None) \
+        else "score"
+    if want_op not in info["row_dims"]:
+        print(json.dumps({"error": f"tier does not serve {want_op!r}"}),
               file=sys.stderr, flush=True)
         cli.close()
         return 2
     ks = [int(s) for s in args.k_sweep.split(",") if s]
-    dim = info["row_dims"]["score"]
+    dim = info["row_dims"][want_op]
+    # --target-se / --ess-floor switch the sweep to the adaptive op: the
+    # swept values become sample CAPS, and measured k_used is reported
+    # next to latency (the estimated-work signal the router balances on)
+    adaptive = args.target_se is not None or args.ess_floor is not None
     rng = np.random.RandomState(args.seed)
     sizes = [int(s) for s in args.sizes.split(",") if s]
     walls: dict = {k: [] for k in ks}
+    k_used: dict = {k: [] for k in ks}
     errors: dict = {}
     rows_ok = 0
     t0 = time.perf_counter()
@@ -615,7 +645,14 @@ def _client_k_sweep(cli, args) -> int:
         batch = (rng.rand(n, dim) > 0.5).astype(np.float32)
         t1 = time.perf_counter()
         try:
-            out = cli.score(batch.tolist(), k=k, model=args.model)
+            if adaptive:
+                out = cli.score_adaptive(batch.tolist(), k=k,
+                                         model=args.model,
+                                         target_se=args.target_se,
+                                         ess_floor=args.ess_floor)
+                k_used[k].extend(row[2] for row in out)
+            else:
+                out = cli.score(batch.tolist(), k=k, model=args.model)
             rows_ok += len(out)
             walls[k].append(time.perf_counter() - t1)
         except TierError as e:
@@ -627,7 +664,13 @@ def _client_k_sweep(cli, args) -> int:
                  "p50_s": round(float(np.percentile(w, 50)), 6) if w else None,
                  "p95_s": round(float(np.percentile(w, 95)), 6) if w else None}
         for k, w in walls.items()}
+    if adaptive:
+        for k, used in k_used.items():
+            if used:
+                per_k[str(k)]["k_used_mean"] = round(float(np.mean(used)), 1)
+                per_k[str(k)]["k_used_max"] = int(max(used))
     print(json.dumps({"mode": "client-k-sweep", "target": args.client,
+                      "op": "score_adaptive" if adaptive else "score",
                       "k_sweep": ks, "per_k": per_k, "ok_rows": rows_ok,
                       "errors": errors, "wall_seconds": round(wall, 3),
                       "info": {key: info[key] for key in

@@ -62,6 +62,7 @@ from iwae_replication_project_tpu.serving.buckets import (
     BucketLadder,
     as_row,
     as_rows,
+    validate_adaptive_target,
     validate_k,
     validate_model,
     validate_precision,
@@ -321,10 +322,18 @@ class ServingEngine:
     # request API
     # ------------------------------------------------------------------
 
+    #: ops whose program takes an accuracy target (``score_adaptive``) —
+    #: their submits are validated through the shared adaptive-target
+    #: validator and their ``k`` is the cap, not the sample count. Empty on
+    #: the base engine (the mesh-backed subclass registers the adaptive op).
+    _ADAPTIVE_OPS: Tuple[str, ...] = ()
+
     def submit(self, op: str, row, k: Optional[int] = None, *,
                seed: Optional[int] = None,
                model: Optional[str] = None,
-               trace=None) -> Future:
+               trace=None,
+               target_se: Optional[float] = None,
+               ess_floor: Optional[float] = None) -> Future:
         """Enqueue ONE example; returns its Future. Raises
         :class:`EngineOverloaded` when the queue bound is hit.
 
@@ -365,11 +374,26 @@ class ServingEngine:
             # request with the wrong weights
             validate_model(model, self.models or ())
         _, takes_k = self._programs[op]
-        # typed bad_request for out-of-range k at the engine boundary: a k
-        # past k_max must never reach program build (for the single-device
-        # static-k programs that would be a silent giant compile)
-        k = validate_k(self.k if k is None else k, self.k_max) \
-            if takes_k else 0
+        if op in self._ADAPTIVE_OPS:
+            # the typed bad_request of the adaptive contract, via the ONE
+            # shared validator (serving/buckets.py): k is the cap here, and
+            # a target-less / malformed-target request must die at this
+            # boundary, never inside a replica program
+            target_se, ess_floor, k = validate_adaptive_target(
+                target_se, ess_floor, self.k if k is None else k, self.k_max)
+        elif target_se is not None or ess_floor is not None:
+            raise ValueError(
+                f"target_se/ess_floor only apply to adaptive ops "
+                f"({sorted(self._ADAPTIVE_OPS)}); {op!r} is fixed-k — use "
+                f"score_adaptive for accuracy-targeted scoring")
+        else:
+            target_se = ess_floor = 0.0
+            # typed bad_request for out-of-range k at the engine boundary:
+            # a k past k_max must never reach program build (for the
+            # single-device static-k programs that would be a silent giant
+            # compile)
+            k = validate_k(self.k if k is None else k, self.k_max) \
+                if takes_k else 0
         row = as_row(row, self.row_dims[op], op)
         now = self._clock()
         if seed is not None and not 0 <= int(seed) < 2 ** 31:
@@ -385,7 +409,8 @@ class ServingEngine:
             req = Request(op=op, payload=row, k=k, seed=seed, t_enqueue=now,
                           deadline=(now + self.timeout_s
                                     if self.timeout_s is not None else None),
-                          trace=trace)
+                          trace=trace,
+                          target_se=target_se, ess_floor=ess_floor)
             try:
                 self._batcher.submit(req)
             except EngineOverloaded:
@@ -417,6 +442,25 @@ class ServingEngine:
     def decode(self, h) -> np.ndarray:
         """Pixel probabilities decoded from deepest-latent rows."""
         return self._blocking("decode", h, None)
+
+    def score_adaptive(self, x, k_cap: Optional[int] = None, *,
+                       target_se: Optional[float] = None,
+                       ess_floor: Optional[float] = None) -> np.ndarray:
+        """Accuracy-targeted scoring: ``[n, 3]`` rows of
+        ``(log p_hat, achieved_se, k_used)`` (or ``[3]`` for a single row) —
+        each row stops at the first sample-stream prefix meeting
+        ``target_se`` (delta-method SE on ``log p_hat``) and/or
+        ``ess_floor``, capped at ``k_cap``. Blocks until served; only
+        engines registering the adaptive op (the mesh-sharded scorer)
+        accept it."""
+        rows, single = as_rows(x)
+        futures = [self.submit("score_adaptive", r, k=k_cap,
+                               target_se=target_se, ess_floor=ess_floor)
+                   for r in rows]
+        if self._thread is None:
+            self.flush()
+        out = np.stack([f.result() for f in futures])
+        return out[0] if single else out
 
     # ------------------------------------------------------------------
     # dispatch machinery
@@ -607,9 +651,14 @@ class ServingEngine:
         return k
 
     def _dispatch_args(self, op: str, k: int, payload: np.ndarray,
-                       seeds: np.ndarray) -> Tuple[tuple, dict, dict]:
+                       seeds: np.ndarray,
+                       targets: Optional[Tuple[float, float]] = None
+                       ) -> Tuple[tuple, dict, dict]:
         """The (args, kwargs, static_kwargs) of one AOT dispatch — shared by
-        the live path and :meth:`warmup` so both hit the same registry key."""
+        the live path and :meth:`warmup` so both hit the same registry key.
+        ``targets`` is the adaptive op's ``(target_se, ess_floor)`` pair
+        (dynamic scalars, never static); None for fixed-k ops — the base
+        engine registers no adaptive op and ignores it."""
         import jax
 
         _, takes_k = self._programs[op]
@@ -663,7 +712,13 @@ class ServingEngine:
         from iwae_replication_project_tpu.utils.compile_cache import (
             aot_call_async, cache_stats, executable_store, stats_delta)
 
-        op, k = batch[0].group
+        # op/k come from the request fields, NOT a group unpack: the
+        # adaptive coalescing key is a 4-tuple (op, k, target_se,
+        # ess_floor), and every request in a batch shares all four by the
+        # grouping contract (batcher.Request.group)
+        op, k = batch[0].op, batch[0].k
+        targets = (batch[0].target_se, batch[0].ess_floor) \
+            if op in self._ADAPTIVE_OPS else None
         n = len(batch)
         # chaos hook (utils/faults.py; off = one None check): a raise here
         # is the replica-crash signal — it propagates into _launch_routed
@@ -679,7 +734,8 @@ class ServingEngine:
         seeds = np.zeros((bucket,), np.int32)
         seeds[:n] = [r.seed for r in batch]
         program = self._program_for(op, k, bucket)
-        args, kwargs, static = self._dispatch_args(op, k, payload, seeds)
+        args, kwargs, static = self._dispatch_args(op, k, payload, seeds,
+                                                   targets)
         t_args = self._clock()
         # stamp the gate's selection for THIS dispatch's (op, k, bucket) —
         # recomputed from the row's own config via the deterministic gate
@@ -766,7 +822,17 @@ class ServingEngine:
             self._prof_cost_cache[key] = cost  # iwaelint: disable=unlocked-shared-state -- idempotent memo publish: the record is a pure function of the key; racing writers store the identical dict
         return self._prof_cost_cache[key]
 
-    def _profile_dispatch(self, inf: _InFlight, now: float) -> None:
+    def _prof_adaptive(self, inf: _InFlight, out: np.ndarray):
+        """``(flops, total_k_used)`` of an adaptive dispatch, read from the
+        fetched result's k_used column — or None for fixed-k ops. The
+        profiling plane attributes adaptive work at the samples actually
+        drawn, not the cap: a burn rate charged at k_cap could be gamed by
+        easy rows that stopped after one block. Base engine: no adaptive
+        ops, always None."""
+        return None
+
+    def _profile_dispatch(self, inf: _InFlight, now: float,
+                          out: Optional[np.ndarray] = None) -> None:
         """Completion-stage profiling hook: attribute this batch's measured
         device interval (enqueue -> fetched — the completion thread's own
         clock reads, no extra sync) to its (model, program, bucket,
@@ -774,12 +840,18 @@ class ServingEngine:
         t_disp = inf.batch[0].t_dispatch if inf.batch else None
         if t_disp is None:
             return
+        flops = self._prof_flops(inf.op, inf.k, len(inf.batch))
+        samples = None
+        adaptive = self._prof_adaptive(inf, out)
+        if adaptive is not None:
+            flops, samples = adaptive
         self.profiler.observe(
             program=self._aot_name(inf.op), bucket=inf.bucket,
             k_class=self._stamp_k(inf.op, inf.k), rows=len(inf.batch),
             device_s=now - t_disp,
-            flops=self._prof_flops(inf.op, inf.k, len(inf.batch)),
-            cost=self._static_cost_for(inf.op, inf.k, inf.bucket))
+            flops=flops,
+            cost=self._static_cost_for(inf.op, inf.k, inf.bucket),
+            samples=samples)
 
     def _trace_attrs(self, op: str, k: int, bucket: int, n: int) -> dict:
         """Attrs stamped on a traced dispatch's ``engine/dispatch`` span
@@ -840,7 +912,7 @@ class ServingEngine:
             inf.pin.release()
         now = self._clock()
         if self.profiler is not None:
-            self._profile_dispatch(inf, now)
+            self._profile_dispatch(inf, now, out)
         self._emit_trace_spans(inf, t_fetch0, now)
         for i, r in enumerate(inf.batch):
             self.metrics.record_latency(
@@ -892,8 +964,13 @@ class ServingEngine:
                         payload = np.zeros((bucket, self.row_dims[op]),
                                            np.float32)
                         seeds = np.zeros((bucket,), np.int32)
+                        # adaptive targets are DYNAMIC scalars: any value
+                        # warms the bucket's one executable for every
+                        # (k_cap, target_se, ess_floor)
+                        targets = (0.0, 0.0) \
+                            if op in self._ADAPTIVE_OPS else None
                         args, kwargs, static = self._dispatch_args(
-                            op, k, payload, seeds)
+                            op, k, payload, seeds, targets)
                         aot_warm(self._aot_name(op),
                                  self._program_for(op, k, bucket), args,
                                  kwargs=kwargs, static_kwargs=static,

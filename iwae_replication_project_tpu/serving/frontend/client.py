@@ -144,7 +144,9 @@ class TierClient:
 
     def submit(self, op: str, x, k: Optional[int] = None,
                seed: Optional[int] = None,
-               model: Optional[str] = None, trace=None) -> int:
+               model: Optional[str] = None, trace=None,
+               target_se: Optional[float] = None,
+               ess_floor: Optional[float] = None) -> int:
         """Send one request without waiting; returns its wire id. ``seed``
         (single-row payloads only) pins the row's RNG stream — the
         fleet-composition AND retry-parity hook (see protocol.py);
@@ -165,6 +167,10 @@ class TierClient:
         req: Dict[str, Any] = {"id": req_id, "op": op, "x": x}
         if k is not None:
             req["k"] = k
+        if target_se is not None:
+            req["target_se"] = target_se
+        if ess_floor is not None:
+            req["ess_floor"] = ess_floor
         if seed is not None:
             req["seed"] = seed
         if model is not None:
@@ -236,16 +242,37 @@ class TierClient:
 
     def request(self, op: str, x, k: Optional[int] = None,
                 seed: Optional[int] = None,
-                model: Optional[str] = None) -> List[Any]:
+                model: Optional[str] = None,
+                target_se: Optional[float] = None,
+                ess_floor: Optional[float] = None) -> List[Any]:
         if self._retry is None:
-            return self.wait(self.submit(op, x, k=k, seed=seed, model=model))
-        return self._request_retrying(op, x, k, seed, model)
+            return self.wait(self.submit(op, x, k=k, seed=seed, model=model,
+                                         target_se=target_se,
+                                         ess_floor=ess_floor))
+        return self._request_retrying(op, x, k, seed, model,
+                                      target_se, ess_floor)
 
     def score(self, x, k: Optional[int] = None,
               seed: Optional[int] = None,
               model: Optional[str] = None) -> List[Any]:
         """Per-row k-sample IWAE log p̂(x) (list of floats)."""
         return self.request("score", x, k=k, seed=seed, model=model)
+
+    def score_adaptive(self, x, k: Optional[int] = None,
+                       seed: Optional[int] = None,
+                       model: Optional[str] = None, *,
+                       target_se: Optional[float] = None,
+                       ess_floor: Optional[float] = None) -> List[Any]:
+        """Accuracy-targeted scoring: per-row ``[log_px, achieved_se,
+        k_used]`` triples. ``k`` is the sample CAP (fleet ``k_max`` when
+        unset); at least one of ``target_se`` / ``ess_floor`` must be a
+        positive number (the typed-``bad_request`` contract otherwise).
+        Retrying/hedging is as safe as for ``score``: results — k_used
+        included — are a pure function of (weights, payload, seed, k,
+        targets)."""
+        return self.request("score_adaptive", x, k=k, seed=seed,
+                            model=model, target_se=target_se,
+                            ess_floor=ess_floor)
 
     def encode(self, x, k: Optional[int] = None,
                seed: Optional[int] = None,
@@ -260,7 +287,9 @@ class TierClient:
 
     def _request_retrying(self, op: str, x, k: Optional[int],
                           seed: Optional[int],
-                          model: Optional[str] = None) -> List[Any]:
+                          model: Optional[str] = None,
+                          target_se: Optional[float] = None,
+                          ess_floor: Optional[float] = None) -> List[Any]:
         """The RetryPolicy loop: reconnect + resend across connection
         failures, back off and resend on typed retryable errors, give up
         at max_attempts or the overall deadline — whichever first. Raises
@@ -289,9 +318,12 @@ class TierClient:
                     self._ensure_connected()
                     rid = self.submit(op, x, k=k, seed=seed, model=model,
                                       trace=(aspan.ctx() if aspan is not None
-                                             else None))
+                                             else None),
+                                      target_se=target_se,
+                                      ess_floor=ess_floor)
                     out = self._await(rid, op, x, k, seed, model, deadline,
-                                      span=aspan)
+                                      span=aspan, target_se=target_se,
+                                      ess_floor=ess_floor)
                     if aspan is not None:
                         aspan.finish()
                     if root is not None:
@@ -336,7 +368,9 @@ class TierClient:
                 root.finish(error="failed")
 
     def _await(self, rid: int, op: str, x, k, seed, model,
-               deadline: Optional[float], span=None) -> List[Any]:
+               deadline: Optional[float], span=None,
+               target_se: Optional[float] = None,
+               ess_floor: Optional[float] = None) -> List[Any]:
         """Wait for `rid`, hedging to a second connection when the policy
         asks for it and the primary is slow. ``span`` is the attempt span
         a hedge records its ``client/hedge`` child under."""
@@ -370,7 +404,8 @@ class TierClient:
         try:
             hrid = hedge.submit(op, x, k=k, seed=seed, model=model,
                                 trace=(hspan.ctx() if hspan is not None
-                                       else None))
+                                       else None),
+                                target_se=target_se, ess_floor=ess_floor)
             results: "_queue.Queue" = _queue.Queue()
 
             def waiter(tag: str, cli: "TierClient", r: int) -> None:
@@ -461,6 +496,33 @@ class TierClient:
         "slo": {per-(model, op) burn rates}}`` — the autoscaler's wire
         signal (:func:`~..fleet.signals.wire_signals` consumes it)."""
         return self._control("slo")
+
+    def submit_job(self, x, *, job_op: str = "score",
+                   k: Optional[int] = None,
+                   target_se: Optional[float] = None,
+                   ess_floor: Optional[float] = None,
+                   seed: Optional[int] = None,
+                   model: Optional[str] = None,
+                   checkpoint_dir: Optional[str] = None,
+                   checkpoint_every: Optional[int] = None,
+                   resume: Optional[bool] = None) -> Dict[str, Any]:
+        """Admit one bulk offline job (``submit_job`` wire op, jobs.py):
+        every row of ``x`` scored through ``job_op`` in the background,
+        below interactive traffic. Returns the job's initial status doc
+        (``doc["job"]`` is the id for :meth:`job_status`)."""
+        return self._control("submit_job", x=x, job_op=job_op, k=k,
+                             target_se=target_se, ess_floor=ess_floor,
+                             seed=seed, model=model,
+                             client=self.client_id,
+                             checkpoint_dir=checkpoint_dir,
+                             checkpoint_every=checkpoint_every,
+                             resume=resume)
+
+    def job_status(self, job: str,
+                   results: Optional[bool] = None) -> Dict[str, Any]:
+        """One job's typed status doc (``results=True`` includes the
+        per-row results collected so far — None for unfinished rows)."""
+        return self._control("job_status", job=job, results=results)
 
     def traces(self, limit: Optional[int] = None,
                trace_id: Optional[str] = None,

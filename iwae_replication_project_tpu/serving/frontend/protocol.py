@@ -6,14 +6,26 @@ replicas finish), so callers match on the echoed ``id``.
 
 Request object::
 
-    {"op": "score" | "encode" | "decode",   # required
+    {"op": "score" | "encode" | "decode" | "score_adaptive",  # required
      "x": [..row..] | [[..rows..]],          # required payload
-     "k": 50,                                # optional (score/encode only)
+     "k": 50,                                # optional (score/encode only;
+                                             #  the k CAP for adaptive ops)
+     "target_se": 0.1,                       # adaptive ops only: stop when
+     "ess_floor": 64,                        #  SE <= target / ESS >= floor
      "id": <any JSON value>,                 # optional, echoed verbatim
      "client": "tenant-a",                   # optional quota principal
      "model": "table1-iwae-1l-k50",          # optional tenant model
      "trace": "<tid>[/<span>]",              # optional trace context
      "seed": 17}                             # optional, single-row only
+
+``target_se`` / ``ess_floor`` are the adaptive accuracy contract
+(``score_adaptive``): at least one must be set (a finite positive number),
+``k`` becomes the sample CAP (defaulting to the fleet's ``k_max``), and
+each row's result is the triple ``[log_px, achieved_se, k_used]``. The
+shared validator (buckets.validate_adaptive_target) runs at the wire, the
+router, and the engine — a malformed target is a typed ``bad_request``
+*response* at every depth and the connection survives. Targets on a
+non-adaptive op are likewise ``bad_request``.
 
 ``model`` names WHICH zoo model's weights must serve the request on a
 multi-model tier (``iwae-serve --models``): the router classifies it onto
@@ -104,6 +116,26 @@ ERROR_CODES = ("bad_request", "overloaded", "quota_exceeded", "timeout",
 #: document (``slo`` — the scaling signal a fleet-of-fleets parent reads
 #: over the wire instead of scraping Prometheus text)
 CONTROL_OPS = ("info", "stats", "traces", "slo")
+
+#: the bulk offline lane's ops (jobs.py), answered synchronously like
+#: control ops — the job's ROWS are pumped through the router in the
+#: background, below interactive traffic:
+#:
+#: ``{"op": "submit_job", "job_op": "score_adaptive", "x": [[..rows..]],
+#:    "k": 5000, "target_se": 0.1, "seed": 7, "client": "tenant-a",
+#:    "checkpoint_dir": "/path", "checkpoint_every": 256,
+#:    "resume": false}``  ->  the job's initial status document
+#: ``{"op": "job_status", "job": "job-1", "results": true}``  ->  state,
+#:    row counts, checkpoint progress, optionally per-row results
+#:
+#: Row ``i`` runs under seed ``(seed + i) mod 2**31``, so job results are
+#: bitwise independent of pump pacing and interruption; checkpoints are
+#: sealed with the training-checkpoint manifest machinery and ``resume``
+#: restores the newest intact prefix without resubmitting it. Malformed
+#: job docs are typed ``bad_request`` responses; job ops are never quota'd
+#: themselves (each submitted row chunk is, through the same per-(client,
+#: model) buckets as interactive traffic).
+JOB_OPS = ("submit_job", "job_status")
 
 #: max accepted request line (bytes) — a framing bound, not a row bound:
 #: 64 MiB comfortably fits a max_batch x 784-float payload and stops a

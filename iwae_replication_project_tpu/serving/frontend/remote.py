@@ -167,6 +167,10 @@ class RemoteEngine:
         child_models = doc.get("models") or {}
         self.models = frozenset(child_models) if child_models else None
         self.model = doc.get("default_model")
+        # adaptive capability forwarding: which of the child's ops take
+        # accuracy targets — a parent tier's info doc and router read the
+        # same attribute the in-process engines expose
+        self._ADAPTIVE_OPS = tuple(doc.get("adaptive_ops") or ())
         self.info = doc
         self._sock = sock
         self._reader = reader
@@ -233,7 +237,9 @@ class RemoteEngine:
     def submit(self, op: str, row, k: Optional[int] = None, *,
                seed: Optional[int] = None,
                model: Optional[str] = None,
-               trace=None) -> Future:
+               trace=None,
+               target_se: Optional[float] = None,
+               ess_floor: Optional[float] = None) -> Future:
         """One row to the child tier; returns the proxy Future.
 
         ``trace`` (a :class:`~...telemetry.tracing.TraceContext`) records
@@ -263,6 +269,20 @@ class RemoteEngine:
         req: Dict[str, Any] = {"op": op, "x": row}
         if k is not None:
             req["k"] = int(k)
+        if target_se is not None or ess_floor is not None:
+            # adaptive targets ride the wire unchanged — the child tier's
+            # own boundary validation answers malformed values with a
+            # typed bad_request, which maps back to ValueError here (the
+            # same shape the in-process engine raises synchronously)
+            if op not in self._ADAPTIVE_OPS:
+                raise ValueError(
+                    f"target_se/ess_floor only apply to adaptive ops; "
+                    f"this tier declares {sorted(self._ADAPTIVE_OPS)}, "
+                    f"got op {op!r}")
+            if target_se is not None:
+                req["target_se"] = float(target_se)
+            if ess_floor is not None:
+                req["ess_floor"] = float(ess_floor)
         if model is not None:
             req["model"] = model
         if seed is not None:

@@ -101,6 +101,8 @@ from iwae_replication_project_tpu.serving.batcher import (
     complete_future,
 )
 from iwae_replication_project_tpu.serving.buckets import (
+    target_class,
+    validate_adaptive_target,
     validate_k,
     validate_model,
 )
@@ -111,6 +113,17 @@ from iwae_replication_project_tpu.serving.faults import (
 from iwae_replication_project_tpu.telemetry.registry import MetricRegistry
 
 __all__ = ["ReplicaRouter", "TierOverloaded", "ReplicaUnavailable"]
+
+#: ops carrying an accuracy target instead of a fixed sample count — their
+#: k is the CAP, their results end in a measured ``k_used`` column, and the
+#: router dispatches them by least ESTIMATED work (the per-(op, target
+#: class) EWMA of measured k_used) instead of least inflight
+ADAPTIVE_OPS = ("score_adaptive",)
+
+#: EWMA weight of the per-(op, target-class) measured-k_used estimator —
+#: fast enough to track a workload shift within tens of requests, slow
+#: enough that one freak row does not flip placement
+WORK_EWMA_ALPHA = 0.3
 
 
 class TierOverloaded(RuntimeError):
@@ -155,6 +168,17 @@ class _Tracked:
     #: completed — guards the outstanding-count decrement against the
     #: duplicate completions rerouting can produce
     finalized: bool = False
+    #: adaptive accuracy target (``score_adaptive``; 0.0 = criterion
+    #: disabled) — forwarded verbatim to the serving replica
+    target_se: float = 0.0
+    ess_floor: float = 0.0
+    #: the coarse (decade-quantized) target class this request's measured
+    #: k_used is attributed under; None = fixed-k traffic
+    tclass: Optional[str] = None
+    #: estimated samples this request will draw (adaptive: the target
+    #: class's k_used EWMA, capped at k; fixed-k: the request k) — what the
+    #: estimated-work selection sums per replica
+    work: float = 1.0
 
 
 class _Replica:
@@ -255,6 +279,11 @@ class ReplicaRouter:
         #: verbatim across every fleet-shape recompute
         self._large_k_explicit = large_k_threshold
         self._affinity: Dict[Tuple, int] = {}
+        #: (op, target-class) -> EWMA of measured per-row k_used — the
+        #: estimated-work weight adaptive dispatch balances on (guarded by
+        #: the router lock; fed by _on_engine_done from each adaptive
+        #: result's k_used column)
+        self._work_ewma: Dict[Tuple[str, Optional[str]], float] = {}
         #: where a model-less request lands in an all-labeled fleet: the
         #: FIRST replica's default model — resolved at admission so results
         #: are a pure function of the request, never of replica choice.
@@ -500,7 +529,9 @@ class ReplicaRouter:
     def submit(self, op: str, row, k: Optional[int] = None, *,
                seed: Optional[int] = None,
                model: Optional[str] = None,
-               trace=None) -> Future:
+               trace=None,
+               target_se: Optional[float] = None,
+               ess_floor: Optional[float] = None) -> Future:
         """Admit and dispatch one request row; returns the tier Future.
 
         ``trace`` is an optional
@@ -534,7 +565,27 @@ class ReplicaRouter:
             raise ValueError(f"unknown op {op!r}; this fleet serves "
                              f"{served}")
         model = self.resolve_model(model)
-        if k is not None:
+        tclass: Optional[str] = None
+        if op in ADAPTIVE_OPS:
+            # typed bad_request at the tier boundary, via the ONE shared
+            # validator: the cap defaults to the fleet bound (resolved at
+            # ADMISSION, so the request is fully specified before any
+            # replica is chosen — results stay a pure function of it)
+            if k is None:
+                if self.k_max is None:
+                    raise ValueError(
+                        "score_adaptive needs an explicit k cap: no replica "
+                        "in this fleet declares a k_max to default to")
+                k = self.k_max
+            target_se, ess_floor, k = validate_adaptive_target(
+                target_se, ess_floor, k,
+                self.k_max if self.k_max is not None else 2 ** 31 - 1)
+            tclass = target_class(target_se, ess_floor)
+        elif target_se is not None or ess_floor is not None:
+            raise ValueError(
+                f"target_se/ess_floor only apply to adaptive ops "
+                f"({sorted(ADAPTIVE_OPS)}); {op!r} is fixed-k")
+        elif k is not None:
             # typed bad_request at the tier boundary: an out-of-range k is
             # rejected HERE, before it can occupy the ceiling or reach a
             # replica as an internal error (the engines re-validate against
@@ -557,7 +608,10 @@ class ReplicaRouter:
             self._ticket_counter += 1
             t = _Tracked(ticket=self._ticket_counter, op=op, row=row, k=k,
                          seed=int(seed), future=fut, model=model,
-                         trace=trace)
+                         trace=trace,
+                         target_se=target_se or 0.0,
+                         ess_floor=ess_floor or 0.0, tclass=tclass,
+                         work=self._estimated_work_locked(op, tclass, k))
             self._outstanding_total += 1
             self.registry.gauge("router/outstanding").set(
                 self._outstanding_total)
@@ -587,6 +641,40 @@ class ReplicaRouter:
 
     # -- selection + dispatch ----------------------------------------------
 
+    def _estimated_work_locked(self, op: str, tclass: Optional[str],
+                               k: Optional[int]) -> float:
+        """Estimated samples one request will draw (caller holds the lock).
+        Fixed-k traffic costs exactly its k; adaptive traffic costs its
+        (op, target-class) measured-k_used EWMA, capped at the request's
+        own cap — before any measurement exists, the cap itself (the
+        conservative prior: over-estimating new traffic spreads it, which
+        is the safe failure mode)."""
+        base = float(k) if k is not None else 1.0
+        if tclass is None:
+            return base
+        est = self._work_ewma.get((op, tclass))
+        return min(est, base) if est is not None else base
+
+    def work_estimates(self) -> Dict[str, float]:
+        """The live per-(op, target-class) measured-k_used EWMAs (stats /
+        debugging surface; keys rendered ``op/class``)."""
+        with self._lock:
+            return {f"{op}/{tc}": est
+                    for (op, tc), est in self._work_ewma.items()}
+
+    def _note_k_used(self, t: _Tracked, result) -> None:
+        """Fold an adaptive result's measured k_used column into its
+        target class's work EWMA (the estimated-work dispatch weight)."""
+        try:
+            k_used = float(result[2])
+        except Exception:
+            return    # a fake replica returned a bare scalar: nothing to learn
+        with self._lock:
+            key = (t.op, t.tclass)
+            prev = self._work_ewma.get(key)
+            self._work_ewma[key] = k_used if prev is None else \
+                prev + WORK_EWMA_ALPHA * (k_used - prev)
+
     def _wants_sharded(self, op: str, k: Optional[int]) -> bool:
         """Whether (op, k) belongs to the mesh-backed class: score above
         the threshold (k=None means the replica default — always small)."""
@@ -604,6 +692,11 @@ class ReplicaRouter:
             return False
         if r.k_max is not None and k is not None and k > r.k_max:
             return False
+        if op in ADAPTIVE_OPS:
+            # the adaptive op only exists on replicas that register it (the
+            # mesh-backed scorer; serves() above filtered) — the fast/
+            # sharded k classification does not apply to a cap
+            return True
         if self._wants_sharded(op, k):
             return r.sharded
         return not r.sharded or not self._has_fast
@@ -611,25 +704,43 @@ class ReplicaRouter:
     def _select(self, group: Tuple,
                 exclude: Set[int]) -> Optional[_Replica]:
         """Pick a replica (caller holds the lock): sticky group affinity
-        while balanced, else least-inflight with lowest-index tie-break —
-        over the replicas eligible for this (model, op, k) class."""
-        model, op, k = group
+        while balanced, else least-LOAD with lowest-index tie-break — over
+        the replicas eligible for this (model, op, k[, target-class])
+        group. Load is the outstanding-request count for fixed-k traffic
+        (the historical least-inflight policy, unchanged) and the summed
+        estimated work — each outstanding request's ``work`` samples — for
+        adaptive groups: ten easy rows (k_used ~ 50) must not count like
+        ten k=5000 rows, or an easy-traffic replica would starve while its
+        peer drowns."""
+        model, op, k = group[:3]
+        adaptive = len(group) > 3
+
+        def load(r: _Replica) -> float:
+            if adaptive:
+                return sum(x.work for x in r.outstanding.values())
+            return float(len(r.outstanding))
+
         cands = [r for r in self._replicas
                  if r.healthy and not r.draining and r.index not in exclude
                  and self._eligible(r, op, k, model)]
         if not cands:
             return None
-        least = min(len(r.outstanding) for r in cands)
+        least = min(load(r) for r in cands)
+        # the affinity slack is denominated in requests; for work-based
+        # selection it scales by the group's per-request estimate so the
+        # imbalance tolerance means "this many typical requests" either way
+        slack = self.affinity_slack * (
+            self._estimated_work_locked(op, group[3], k) if adaptive else 1.0)
         aff = self._affinity.get(group)
         if aff is not None:
             ar = self._by_index.get(aff)
             if ar is not None and ar.healthy and not ar.draining and \
                     aff not in exclude and \
                     self._eligible(ar, op, k, model) and \
-                    len(ar.outstanding) <= least + self.affinity_slack:
+                    load(ar) <= least + slack:
                 self._count("affinity_hits")
                 return ar
-        chosen = min(cands, key=lambda r: (len(r.outstanding), r.index))
+        chosen = min(cands, key=lambda r: (load(r), r.index))
         self._affinity[group] = chosen.index
         return chosen
 
@@ -653,9 +764,14 @@ class ReplicaRouter:
         failures; raises the typed error when the fleet cannot take it."""
         from iwae_replication_project_tpu.telemetry.tracing import start_span
         any_shed = False
+        # adaptive groups key affinity/selection by target class too: one
+        # class's warm replica keeps its traffic, and the work-based load
+        # comparison applies only within the adaptive family
+        group = (t.model, t.op, t.k) if t.tclass is None \
+            else (t.model, t.op, t.k, t.tclass)
         while True:
             with self._lock:
-                r = self._select((t.model, t.op, t.k), exclude)
+                r = self._select(group, exclude)
                 if r is None:
                     break
                 r.outstanding[t.ticket] = t
@@ -685,6 +801,11 @@ class ReplicaRouter:
                     kw["model"] = t.model
                 if t.span is not None and r.traces:
                     kw["trace"] = t.span.ctx()
+                if t.tclass is not None:
+                    # 0.0 means disabled at the wire/tracking layer; the
+                    # engine's validator wants None for a disabled criterion
+                    kw["target_se"] = t.target_se or None
+                    kw["ess_floor"] = t.ess_floor or None
                 ef = r.engine.submit(t.op, t.row, k=t.k, seed=t.seed, **kw)
             except EngineOverloaded as e:
                 any_shed = True
@@ -795,7 +916,12 @@ class ReplicaRouter:
                 # span; an abandoned dispatch's late success must not touch
                 # the live attempt's
                 self._finish_span(t)
-            self._finalize(t, result=ef.result())
+            result = ef.result()
+            if t.tclass is not None:
+                # measured k_used feeds the estimated-work weight this
+                # class's NEXT requests dispatch under
+                self._note_k_used(t, result)
+            self._finalize(t, result=result)
             return
         if not owns or finalized:
             return

@@ -36,6 +36,7 @@ import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 from iwae_replication_project_tpu.serving.buckets import (
+    validate_adaptive_target,
     validate_k,
     validate_precision,
 )
@@ -169,6 +170,19 @@ class _Connection:
                 if op != "traces" else self._tier.traces_doc(obj)
             self._write(protocol.ok_response(req_id, doc))
             return
+        if op in protocol.JOB_OPS:
+            # the bulk offline lane (jobs.py): job admission/status are
+            # answered synchronously — the job's ROWS are pumped through
+            # the router in the background, below interactive traffic.
+            # Malformed job docs are typed bad_request responses and the
+            # connection survives, like every other request.
+            try:
+                doc = self._tier.job_doc(op, obj)
+            except Exception as e:
+                self._respond_error(req_id, e)
+                return
+            self._write(protocol.ok_response(req_id, doc))
+            return
         t_start = self._tier.clock()
         span = None
         model = None
@@ -223,6 +237,21 @@ class _Connection:
                     k = validate_k(k, 2 ** 31 - 1)
                 except ValueError as e:
                     raise protocol.ProtocolError(str(e)) from None
+            target_se = obj.get("target_se")
+            ess_floor = obj.get("ess_floor")
+            if target_se is not None or ess_floor is not None:
+                # the wire surface of the adaptive-target contract: the ONE
+                # shared validator (buckets.validate_adaptive_target —
+                # type/positivity/reachability here; the fleet k_max cap
+                # default is the router's synchronous ValueError, same
+                # typed code). A malformed target is THIS request's
+                # bad_request and the connection survives.
+                try:
+                    validate_adaptive_target(
+                        target_se, ess_floor,
+                        k if k is not None else 2 ** 31 - 1, 2 ** 31 - 1)
+                except ValueError as e:
+                    raise protocol.ProtocolError(str(e)) from None
             seed = obj.get("seed")
             if seed is not None:
                 # the fleet-composition hook (protocol.py): one seed names
@@ -243,8 +272,14 @@ class _Connection:
                     raise protocol.ProtocolError(
                         "'seed' applies to single-row payloads only")
             if span is not None:
-                span.annotate(rows=len(rows), model=model,
-                              **({"k": k} if k is not None else {}))
+                ann: Dict[str, Any] = {}
+                if k is not None:
+                    ann["k"] = k
+                if target_se is not None:
+                    ann["target_se"] = target_se
+                if ess_floor is not None:
+                    ann["ess_floor"] = ess_floor
+                span.annotate(rows=len(rows), model=model, **ann)
             t_admit = self._tier.clock()
             self._tier.admit(client, len(rows), model=model)
             if span is not None:
@@ -261,10 +296,19 @@ class _Connection:
             futures = []
             try:
                 ctx = span.ctx() if span is not None else None
+                kw: Dict[str, Any] = {}
+                # forward adaptive targets only when the client set them —
+                # the plain-op call shape is unchanged (fake routers in
+                # tests keep their historical signatures)
+                if target_se is not None:
+                    kw["target_se"] = target_se
+                if ess_floor is not None:
+                    kw["ess_floor"] = ess_floor
                 for row in rows:
                     futures.append(
                         self._tier.router.submit(op, row, k=k, seed=seed,
-                                                 model=model, trace=ctx))
+                                                 model=model, trace=ctx,
+                                                 **kw))
             except Exception:
                 # partial admission: rows already routed complete and are
                 # discarded; the request as a unit gets the typed error —
@@ -365,6 +409,7 @@ class ServingTier:
                  monitor_interval_s: float = 0.25,
                  large_k_threshold: Optional[int] = None,
                  shed_retry_after_s: float = 0.05,
+                 bulk_headroom: Optional[int] = None,
                  registry=None, tracing: bool = True, recorder=None,
                  slo=None):
         self.router = ReplicaRouter(
@@ -404,6 +449,18 @@ class ServingTier:
         #: that carry no exact wait of their own (queue-shed recovery time
         #: is unknowable server-side; this is the tier's suggested pause)
         self.shed_retry_after_s = float(shed_retry_after_s)
+        # the bulk offline lane (jobs.py): dataset-sized jobs pumped
+        # through the router below interactive traffic — the pump fills
+        # idle capacity only up to `bulk_headroom` outstanding requests
+        # (default: a quarter of the admission ceiling), yielding the rest
+        # to latency traffic
+        from iwae_replication_project_tpu.serving.frontend.jobs import (
+            BulkJobManager)
+        self.jobs = BulkJobManager(
+            self.router, admit=self.admit, refund=self.refund,
+            headroom=(bulk_headroom if bulk_headroom is not None
+                      else max(1, max_outstanding // 4)),
+            registry=self.registry)
         self._host = host
         self._requested_port = port
         self._monitor_interval_s = monitor_interval_s
@@ -508,6 +565,14 @@ class ServingTier:
             return chrome_trace_events(docs)
         return {"stats": stats, "traces": docs}
 
+    def job_doc(self, op: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """Answer one bulk-lane wire op (protocol.JOB_OPS): job admission
+        or status. Malformed docs raise ValueError — the connection's
+        handler maps it to a typed ``bad_request`` response."""
+        if op == "submit_job":
+            return self.jobs.submit(obj)
+        return self.jobs.status(obj)
+
     def slo_doc(self) -> Dict[str, Any]:
         """The ``{"op": "slo"}`` control response: the SLOMonitor's
         burn-rate + objective snapshot (telemetry/slo.py schema) — the
@@ -565,6 +630,11 @@ class ServingTier:
         return {
             "ops": sorted(row_dims),
             "row_dims": row_dims,
+            # which ops take accuracy targets (target_se/ess_floor) — the
+            # union over the fleet, like ops/row_dims
+            "adaptive_ops": sorted({op for e in engines
+                                    for op in getattr(e, "_ADAPTIVE_OPS",
+                                                      ())}),
             "models": models,
             "default_model": self.router.default_model,
             "k": getattr(fast_t, "k", None),
@@ -604,6 +674,10 @@ class ServingTier:
                        if name.startswith("router/")},
             "gauges": {name: v for name, v in snap["gauges"].items()
                        if name.startswith("router/")},
+            # the router's learned per-(op, target-class) k_used EWMAs —
+            # what least-estimated-work dispatch weighs adaptive traffic by
+            "work_estimates": self.router.work_estimates(),
+            "jobs": self.jobs.jobs_doc(),
             "store": store,
             "replicas": self.router.replica_states(),
             "engines": [_engine_counters(e) for e in self.router.engines],
@@ -611,7 +685,8 @@ class ServingTier:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def warmup(self, ops: Iterable[str] = ("score", "encode", "decode"),
+    def warmup(self, ops: Iterable[str] = ("score", "encode", "decode",
+                                           "score_adaptive"),
                ks=None) -> Dict[str, float]:
         """Warm every replica's bucket ladder (AOT pre-compile); replicas
         share the process AOT registry in-process, so replica 2+ warmups
@@ -633,6 +708,7 @@ class ServingTier:
         for e in self.router.engines:
             e.start()
         self.router.start_monitor(self._monitor_interval_s)
+        self.jobs.start()
         if self._listener is None:
             lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -686,6 +762,10 @@ class ServingTier:
         if self._accept_thread is not None:
             self._accept_thread.join()
             self._accept_thread = None
+        # the bulk pump stops BEFORE the drain: its already-submitted rows
+        # complete below; unsubmitted rows stay unsubmitted — exactly the
+        # interruption the job checkpoint/resume contract covers
+        self.jobs.stop()
         # drain the fleet: every tier future completes (result or typed
         # error) before this returns
         self.router.drain(timeout_s=timeout_s)

@@ -125,6 +125,50 @@ def make_sharded_score_rows(cfg: model.ModelConfig, mesh, k_chunk: int = 250):
     ))
 
 
+@functools.lru_cache(maxsize=32)
+def make_sharded_score_adaptive(cfg: model.ModelConfig, mesh,
+                                k_chunk: int = 250):
+    """The accuracy-targeted adaptive ``score_adaptive`` program:
+    ``(params, base_key, seeds[B], x[B, d], k_cap[int32], target_se[f32],
+    ess_floor[f32]) -> [B, 3]`` rows of ``(log p_hat, achieved_se, k_used)``.
+
+    The adaptive sibling of :func:`make_sharded_score_rows`: same mesh
+    split (rows over dp, sample blocks over sp), same per-(seed, global
+    block) RNG stream, but the engine stops each row at the first
+    stream-prefix whose delta-method SE (or ESS) meets the client's target
+    — see :func:`~...parallel.eval._local_row_adaptive_log_px` for the
+    two-phase stopping/recompute scheme and its bitwise
+    early-stopped-prefix == fixed-k-prefix contract.
+
+    All three targets ride as *dynamic* replicated scalars (<= 0 disables a
+    criterion), so one executable per batch bucket serves every
+    (k_cap, target_se, ess_floor) — a warmed engine takes a ragged
+    (batch, target) stream with zero recompiles, exactly like the fixed
+    dynamic-k program.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from iwae_replication_project_tpu.parallel.eval import (
+        _local_row_adaptive_log_px,
+    )
+    from iwae_replication_project_tpu.parallel.mesh import AXES, shard_map
+
+    n_sp = mesh.shape[AXES.sp]
+
+    def local_fn(params, base_key, seeds_local, x_local, k_cap, target_se,
+                 ess_floor):
+        return _local_row_adaptive_log_px(params, cfg, base_key, seeds_local,
+                                          x_local, k_cap, target_se,
+                                          ess_floor, k_chunk, n_sp)
+
+    return jax.jit(shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(), P(), P(AXES.dp), P(AXES.dp), P(), P(), P()),
+        out_specs=P(AXES.dp),
+        check_vma=False,
+    ))
+
+
 #: op name -> (jitted program, takes static k?)
 PROGRAMS = {
     "score": (score_rows, True),
@@ -152,4 +196,8 @@ PADDED_ROW_KWARGS = {
     # the mesh-sharded large-k score program (make_sharded_score_rows):
     # same per-row payload contract, dispatched by ShardedScoreEngine
     "score_sharded": ("seeds", "x"),
+    # the accuracy-targeted adaptive scorer (make_sharded_score_adaptive):
+    # identical per-row payload contract — padded rows ride axis 0 of the
+    # seed/payload inputs and must stay masked through both phases
+    "score_adaptive": ("seeds", "x"),
 }

@@ -86,6 +86,9 @@ class ShardedScoreEngine(ServingEngine):
         from iwae_replication_project_tpu.serving.programs import (
             make_sharded_score_rows)
 
+        from iwae_replication_project_tpu.serving.programs import (
+            make_sharded_score_adaptive)
+
         if mesh is None:
             mesh = make_mesh(dp=1, sp=jax.device_count())
         dp = mesh.shape[AXES.dp]
@@ -111,12 +114,18 @@ class ShardedScoreEngine(ServingEngine):
         self.mesh = mesh
         self._dp = dp
         self.sharded = True
-        # one program, one op: this replica IS the large-k scoring service
+        # the large-k scoring service: fixed dynamic-k scoring plus its
+        # accuracy-targeted adaptive sibling (same mesh split, same RNG
+        # stream; k is the CAP there and the targets ride as dynamic
+        # scalars — see serving/programs.make_sharded_score_adaptive)
         self._programs = {
             "score": (make_sharded_score_rows(self.cfg, mesh,
                                               self.menu.k_chunk), True),
+            "score_adaptive": (make_sharded_score_adaptive(
+                self.cfg, mesh, self.menu.k_chunk), True),
         }
-        self.row_dims = {"score": self.cfg.x_dim}
+        self.row_dims = {"score": self.cfg.x_dim,
+                         "score_adaptive": self.cfg.x_dim}
         # re-commit weights + base key replicated over the mesh so every
         # dispatch's input shardings (hence its AOT signature) are stable
         self._params = jax.device_put(self._params,
@@ -125,6 +134,10 @@ class ShardedScoreEngine(ServingEngine):
                                         NamedSharding(mesh, P()))
         self._row_spec = NamedSharding(mesh, P(AXES.dp))
         self._scalar_spec = NamedSharding(mesh, P())
+
+    # the adaptive op's submits route through the shared target validator
+    # (serving/buckets.validate_adaptive_target) and its k is the cap
+    _ADAPTIVE_OPS = ("score_adaptive",)
 
     # -- dispatch plumbing (the hooks the base engine dispatches via) ------
 
@@ -152,11 +165,16 @@ class ShardedScoreEngine(ServingEngine):
         (lru-cached) jitted program; reference buckets share the pinned
         one built at construction."""
         from iwae_replication_project_tpu.serving.programs import (
-            make_sharded_score_rows)
+            make_sharded_score_adaptive,
+            make_sharded_score_rows,
+        )
 
         cfg_d, _, _ = self._kernel_for(op, k, bucket)
         if cfg_d is self.cfg:
             return self._programs[op][0]
+        if op == "score_adaptive":
+            return make_sharded_score_adaptive(cfg_d, self.mesh,
+                                               self.menu.k_chunk)
         return make_sharded_score_rows(cfg_d, self.mesh, self.menu.k_chunk)
 
     def _stamp_k(self, op: str, k: int):
@@ -188,15 +206,26 @@ class ShardedScoreEngine(ServingEngine):
         return attrs
 
     def _dispatch_args(self, op: str, k: int, payload: np.ndarray,
-                       seeds: np.ndarray) -> Tuple[tuple, dict, dict]:
+                       seeds: np.ndarray,
+                       targets: Optional[Tuple[float, float]] = None
+                       ) -> Tuple[tuple, dict, dict]:
         """Positional args of one sharded dispatch: payload/seed rows shard
         over dp, k rides as a replicated dynamic scalar — NOT a static —
-        so every k shares the bucket's one executable."""
+        so every k shares the bucket's one executable. The adaptive op
+        appends its ``(target_se, ess_floor)`` pair the same way: dynamic
+        replicated scalars, so one executable per bucket serves every
+        (k_cap, target) with zero recompiles."""
         import jax
 
         payload_dev, seeds_dev = jax.device_put((payload, seeds),
                                                 self._row_spec)
         k_arr = jax.device_put(np.int32(k), self._scalar_spec)
+        if op in self._ADAPTIVE_OPS:
+            tse, floor = targets if targets is not None else (0.0, 0.0)
+            tse_arr = jax.device_put(np.float32(tse), self._scalar_spec)
+            floor_arr = jax.device_put(np.float32(floor), self._scalar_spec)
+            return ((self._params, self._base_key, seeds_dev, payload_dev,
+                     k_arr, tse_arr, floor_arr), {}, {})
         return ((self._params, self._base_key, seeds_dev, payload_dev,
                  k_arr), {}, {})
 
@@ -208,15 +237,36 @@ class ShardedScoreEngine(ServingEngine):
         # (config, chunk, mesh, bucket) — the zero-recompile contract. The
         # config is the GATE's dispatch config (carries the hot-loop pin),
         # whose resolution is bucket-only, never k (see _resolve_kernel).
-        return ("score_sharded", self._kernel_for(op, k, bucket)[0],
+        # The adaptive targets are dynamic scalars and equally absent: the
+        # op-name prefix alone separates the two program families.
+        prefix = "score_adaptive" if op in self._ADAPTIVE_OPS \
+            else "score_sharded"
+        return (prefix, self._kernel_for(op, k, bucket)[0],
                 self.menu.k_chunk, mesh_fingerprint(self.mesh), bucket)
 
     def _aot_name(self, op: str) -> str:
-        return "serve_score_sharded"
+        return "serve_score_adaptive" if op in self._ADAPTIVE_OPS \
+            else "serve_score_sharded"
 
-    def warmup(self, ops: Sequence[str] = ("score",),
+    def _prof_adaptive(self, inf, out):
+        """Adaptive dispatches attribute the samples they actually drew:
+        total k_used from the fetched result's third column, and FLOPs
+        summed per row at each row's own k_used — never the cap (an
+        easy-row-heavy batch must bill what it computed, or the profiler's
+        MFU and the SLO burn rates could be gamed by cheap rows)."""
+        if inf.op not in self._ADAPTIVE_OPS or out is None:
+            return None
+        from iwae_replication_project_tpu.utils.flops import (
+            serving_score_flops_per_row)
+        k_used = out[:len(inf.batch), 2]
+        flops = float(sum(serving_score_flops_per_row(self.cfg, int(ku))
+                          for ku in k_used))
+        return flops, float(k_used.sum())
+
+    def warmup(self, ops: Sequence[str] = ("score", "score_adaptive"),
                ks: Optional[Iterable[int]] = None) -> Dict[str, float]:
         """Pre-compile the batch ladder — one executable per rung covers
-        the WHOLE k range (k is dynamic), so ``ks`` is only the probe value
-        traced through (default: the engine's k)."""
+        the WHOLE k range (k is dynamic; the adaptive op's targets are
+        dynamic too), so ``ks`` is only the probe value traced through
+        (default: the engine's k)."""
         return super().warmup(ops=ops, ks=ks)

@@ -26,7 +26,10 @@ the registry):
 
 =====================  ====================================================
 ``diag/ess``           mean over datapoints of ESS of the K weights
-``diag/ess_frac``      same, normalized by K (1.0 = perfect coverage)
+``diag/ess_frac``      same, normalized by the ACTUAL sample count (1.0 =
+                       perfect coverage) — dynamic-k callers pass
+                       ``n_samples``; the padded leading axis is never the
+                       denominator
 ``diag/log_weight_var`` mean over datapoints of Var_k[log w]
 ``diag/kl_q_p``        MC estimate of E_q[log q(h|x) - log p(h)]
 ``diag/active_units``  latent units with Var_B[E_q[h|x]] > threshold
@@ -96,12 +99,49 @@ def ess(log_w: jnp.ndarray) -> jnp.ndarray:
     return jnp.exp(2.0 * lse1 - lse2)
 
 
-def weight_diagnostics(log_w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
-    """Batch-mean ESS / ESS-fraction / log-weight variance of one pass."""
-    k = log_w.shape[0]
-    e = jnp.mean(ess(log_w))
-    return {"diag/ess": e, "diag/ess_frac": e / k,
-            "diag/log_weight_var": jnp.mean(jnp.var(log_w, axis=0))}
+def weight_diagnostics(log_w: jnp.ndarray,
+                       n_samples=None) -> Dict[str, jnp.ndarray]:
+    """Batch-mean ESS / ESS-fraction / log-weight variance of one pass.
+
+    ``n_samples`` is the ACTUAL sample count when the leading axis is
+    padded (dynamic-k callers — e.g. the adaptive scorer's masked sample
+    blocks, where unused rows hold ``-inf``). The ``-inf`` rows already
+    drop out of the log-space ESS reduction, but ``diag/ess_frac``'s
+    denominator and the log-weight variance would otherwise silently use
+    the PADDED ``shape[0]`` — under dynamic k that number is wrong, never
+    just imprecise. With ``n_samples`` given (a traced scalar is fine — it
+    never touches program shape), the fraction normalizes by the true
+    count and the variance masks padding out of its moments; ``None``
+    keeps the historical contract: the leading axis IS the sample count.
+    ``n_samples`` may be per-row (``[B]``) or scalar; a zero count yields
+    ``ess = ess_frac = 0`` — a 0/0 NaN would read as a health number.
+    """
+    if n_samples is None:
+        k = log_w.shape[0]
+        e = jnp.mean(ess(log_w))
+        return {"diag/ess": e, "diag/ess_frac": e / k,
+                "diag/log_weight_var": jnp.mean(jnp.var(log_w, axis=0))}
+    n = jnp.asarray(n_samples, log_w.dtype)
+    mask = jnp.arange(log_w.shape[0])[:, None] < n
+    safe_n = jnp.maximum(n, 1.0)
+    masked = jnp.where(mask, log_w, -jnp.inf)
+    # inline the ESS identity instead of calling ess(): an all-masked
+    # column has lse1 = lse2 = -inf, and the naive ``2*lse1 - lse2`` is a
+    # NaN even though the answer (0 samples -> ESS 0) is well-defined —
+    # substitute finite dummies and select, the OnlineLSE never-NaN idiom
+    lse1 = jax.nn.logsumexp(masked, axis=0)
+    lse2 = jax.nn.logsumexp(2.0 * masked, axis=0)
+    empty = jnp.isneginf(lse1)
+    per_row = jnp.where(
+        empty, 0.0, jnp.exp(2.0 * jnp.where(empty, 0.0, lse1)
+                            - jnp.where(empty, 0.0, lse2)))
+    e = jnp.mean(per_row)
+    lw = jnp.where(mask, log_w, 0.0)
+    m = jnp.sum(lw, axis=0) / safe_n
+    d = jnp.where(mask, log_w - m, 0.0)
+    return {"diag/ess": e,
+            "diag/ess_frac": jnp.mean(per_row / safe_n),
+            "diag/log_weight_var": jnp.mean(jnp.sum(d * d, axis=0) / safe_n)}
 
 
 # ---------------------------------------------------------------------------

@@ -153,7 +153,8 @@ class _KeyState:
     """Per-attribution-key EWMA baseline (owner's lock guards it)."""
 
     __slots__ = ("count", "ewma", "ewvar", "last_s", "last_mfu",
-                 "last_hbm_frac", "last_ceiling_ratio", "last_z")
+                 "last_hbm_frac", "last_ceiling_ratio", "last_z",
+                 "ewma_k_used", "last_k_used")
 
     def __init__(self):
         self.count = 0
@@ -164,6 +165,10 @@ class _KeyState:
         self.last_hbm_frac: Optional[float] = None
         self.last_ceiling_ratio: Optional[float] = None
         self.last_z: Optional[float] = None
+        #: per-row sample-count attribution (adaptive-k dispatches only;
+        #: None = this key never reported samples — fixed-k traffic)
+        self.ewma_k_used: Optional[float] = None
+        self.last_k_used: Optional[float] = None
 
 
 class DispatchProfiler:
@@ -228,7 +233,8 @@ class DispatchProfiler:
     def observe(self, *, program: str, bucket: int, k_class,
                 rows: int, device_s: float,
                 flops: Optional[float] = None,
-                cost: Optional[dict] = None) -> Optional[DriftFinding]:
+                cost: Optional[dict] = None,
+                samples: Optional[float] = None) -> Optional[DriftFinding]:
         """Account one completed dispatch; returns the drift finding when
         this sample tripped the detector (else None).
 
@@ -236,10 +242,13 @@ class DispatchProfiler:
         interval for the whole batch; ``flops`` the analytic matmul-FLOP
         count of the batch (utils/flops.py — None skips the MFU gauge);
         ``cost`` the program's static cost record from the executable
-        store (None skips bandwidth/ceiling gauges).  Non-positive
-        intervals (a clock artifact) are clamped to zero, counted, and
-        excluded from the baseline — the detector must never learn from
-        (or alarm on) a negative duration."""
+        store (None skips bandwidth/ceiling gauges); ``samples`` the total
+        importance samples the batch actually drew (adaptive-k dispatches
+        — attribution at measured ``k_used``, so device-time burn can't be
+        gamed by easy rows charged at the cap; None for fixed-k traffic).
+        Non-positive intervals (a clock artifact) are clamped to zero,
+        counted, and excluded from the baseline — the detector must never
+        learn from (or alarm on) a negative duration."""
         cfg = self.config
         if device_s <= 0.0:
             self.registry.counter("prof/clamped_intervals").inc()
@@ -298,6 +307,12 @@ class DispatchProfiler:
             st.last_hbm_frac = hbm_frac
             st.last_ceiling_ratio = ceiling_ratio
             st.last_z = z
+            if samples is not None and rows > 0:
+                k_used = float(samples) / float(rows)
+                st.last_k_used = k_used
+                st.ewma_k_used = k_used if st.ewma_k_used is None else \
+                    st.ewma_k_used + cfg.ewma_alpha * (k_used
+                                                       - st.ewma_k_used)
 
         # publish OUTSIDE the profiler lock (leaf-lock discipline: the
         # registry has its own lock and never calls back)
@@ -313,6 +328,11 @@ class DispatchProfiler:
             reg.gauge(f"prof/ceiling_ratio/{key}").set(ceiling_ratio)
         if z is not None:
             reg.gauge(f"prof/z/{key}").set(z)
+        if samples is not None:
+            reg.counter("prof/samples").inc(int(samples))
+            if rows > 0:
+                reg.gauge(f"prof/k_used/{key}").set(float(samples)
+                                                    / float(rows))
         if finding is not None:
             reg.counter("prof/drift").inc()
         return finding
@@ -331,8 +351,9 @@ class DispatchProfiler:
         pinned in tests/test_telemetry.py): per-key measured state +
         EWMA baselines, the chip peaks in use, and the finding ring."""
         with self._lock:
-            keys = {
-                key: {
+            keys = {}
+            for key, st in self._keys.items():
+                doc = {
                     "count": st.count,
                     "ewma_s": st.ewma,
                     "sigma_s": math.sqrt(max(st.ewvar, 0.0)),
@@ -342,8 +363,13 @@ class DispatchProfiler:
                     "last_ceiling_ratio": st.last_ceiling_ratio,
                     "last_z": st.last_z,
                 }
-                for key, st in self._keys.items()
-            }
+                # k_used attribution only exists for keys that reported
+                # sample counts (adaptive-k traffic) — fixed-k keys keep
+                # the original schema (pinned in tests/test_telemetry.py)
+                if st.ewma_k_used is not None:
+                    doc["ewma_k_used"] = st.ewma_k_used
+                    doc["last_k_used"] = st.last_k_used
+                keys[key] = doc
             findings = [f.to_dict() for f in self._findings]
             dropped = self._dropped_findings
         return {

@@ -1,0 +1,271 @@
+"""Adaptive-k scoring + bulk offline lane smoke stage for scripts/check.py.
+
+One short CPU process that proves the accuracy-targeted serving path's
+hard invariants on a warm engine behind a REAL socket tier:
+
+1. **ragged (batch, target) stream, zero recompiles** — mixed
+   ``score_adaptive`` targets (``target_se`` / ``ess_floor``), caps, and
+   plain fixed-k ``score`` traffic interleave over one warm tier with 0
+   AOT misses and 0 XLA recompiles: targets are dynamic scalars, never
+   program keys;
+2. **early-stop == fixed-k prefix, over the wire** — a pinned-seed
+   adaptive request's ``[log_px, se, k_used]`` has ``log_px`` bitwise
+   equal to a plain ``score`` request at ``k = k_used`` under the same
+   seed (the determinism contract: stopping early IS the fixed-k program,
+   truncated), and re-requesting on a NEW connection reproduces the
+   triple bitwise (routing/connection independence);
+3. **typed bad_request at the wire for malformed targets** — wrong type,
+   non-positive, unreachable ``ess_floor``, targets on a fixed op, and a
+   target-less adaptive call each come back as typed ``bad_request``
+   *responses* on a surviving connection;
+4. **the bulk lane yields to interactive traffic** — with a dataset-sized
+   job running in the background lane, interactive p50 stays within the
+   stated bound (``max(1 s, 8 x idle p50)`` on this CPU box), and the
+   job's results equal the offline twin bitwise (background pacing never
+   touches bits);
+5. **checkpoint + bitwise resume** — a checkpointed job interrupted
+   mid-run by a full tier shutdown resumes on a FRESH tier from its
+   manifest-sealed prefix and finishes bitwise identical to the
+   uninterrupted reference.
+
+Tiny architecture by design: the smoke checks contracts, not throughput —
+``bench.py --adaptive-k`` owns the numbers.
+
+Exit 0 on success, 1 with a message on the first failed check.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# sp-merge coverage needs more than one device (conftest.py's convention)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        flags + " --xla_force_host_platform_device_count=4"
+
+D = 16
+K_CHUNK = 16
+K_MAX = 256
+JOB_SEED = 7
+
+
+def _build_tier(model, jax, np, make_mesh, ShardedScoreEngine, ServingTier,
+                cfg, params, bulk_headroom):
+    mesh = make_mesh()
+    eng = ShardedScoreEngine(params=params, model_config=cfg, mesh=mesh,
+                             k_chunk=K_CHUNK, k_max=K_MAX, k=16,
+                             max_batch=8, timeout_s=120.0)
+    tier = ServingTier([eng], port=0, tracing=False,
+                       bulk_headroom=bulk_headroom)
+    tier.start()
+    tier.warmup()
+    return tier, eng, mesh
+
+
+def main() -> int:
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        setup_persistent_cache)
+
+    setup_persistent_cache(base_dir=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+    import numpy as np
+
+    from iwae_replication_project_tpu.models import iwae as model
+    from iwae_replication_project_tpu.parallel import make_mesh
+    from iwae_replication_project_tpu.parallel.eval import (
+        sharded_score_adaptive_offline)
+    from iwae_replication_project_tpu.serving import ShardedScoreEngine
+    from iwae_replication_project_tpu.serving.frontend.client import (
+        TierClient, TierError)
+    from iwae_replication_project_tpu.serving.frontend.server import (
+        ServingTier)
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        cache_stats, stats_delta)
+
+    cfg = model.ModelConfig(x_dim=D, n_hidden_enc=(16, 8),
+                            n_latent_enc=(8, 4),
+                            n_hidden_dec=(8, 16), n_latent_dec=(8, D))
+    params = model.init_params(jax.random.PRNGKey(0), cfg)
+    tier, eng, mesh = _build_tier(model, jax, np, make_mesh,
+                                  ShardedScoreEngine, ServingTier,
+                                  cfg, params, bulk_headroom=2)
+    cli = TierClient("127.0.0.1", tier.port, timeout_s=120.0)
+
+    info = cli.info()
+    assert "score_adaptive" in info["ops"], info["ops"]
+    assert info["adaptive_ops"] == ["score_adaptive"], info["adaptive_ops"]
+
+    rng = np.random.RandomState(0)
+    x = (rng.rand(8, D) > 0.5).astype(np.float32)
+    rows = [r.tolist() for r in x]
+
+    # -- 1. ragged (batch, target) stream: zero recompiles ------------------
+    s0 = cache_stats()
+    ids = []
+    for i, (n, kw) in enumerate([
+            (3, dict(k=K_MAX, target_se=0.5)),
+            (1, dict(k=64, target_se=0.05)),
+            (4, dict(k=K_MAX, ess_floor=32.0)),
+            (2, dict(k=128, target_se=0.2, ess_floor=8.0)),
+            (2, dict(k=16)),                      # plain fixed-k score
+            (1, dict(k=K_MAX, target_se=1e-6))]):  # cap-limited row
+        op = "score" if "target_se" not in kw and "ess_floor" not in kw \
+            else "score_adaptive"
+        for r in rows[:n]:
+            ids.append(cli.submit(op, r, **kw))
+    resp = cli.drain(ids)
+    for rid, r in resp.items():
+        assert r.get("ok"), f"stream request {rid} failed: {r}"
+    d = stats_delta(s0)
+    assert d["aot_misses"] == 0, f"ragged (batch, target) stream missed: {d}"
+    assert d["persistent_cache_misses"] == 0, f"XLA recompiled: {d}"
+
+    # -- 2. early-stop == fixed-k prefix + connection independence ----------
+    for seed, tse in ((11, 0.4), (12, 0.15)):
+        triple = cli.score_adaptive(rows[0], k=K_MAX, seed=seed,
+                                    target_se=tse)[0]
+        log_px, se, k_used = triple
+        assert 0 < k_used <= K_MAX and se <= tse or k_used == K_MAX, triple
+        fixed = cli.score(rows[0], k=int(k_used), seed=seed)[0]
+        assert fixed == log_px, \
+            f"adaptive log_px != fixed-k prefix at k_used={k_used}: " \
+            f"{log_px} vs {fixed}"
+        cli2 = TierClient("127.0.0.1", tier.port, timeout_s=120.0)
+        again = cli2.score_adaptive(rows[0], k=K_MAX, seed=seed,
+                                    target_se=tse)[0]
+        cli2.close()
+        assert again == triple, \
+            f"new-connection re-request changed bits: {again} vs {triple}"
+
+    # -- 3. typed bad_request for malformed targets, connection survives ----
+    bad = [dict(op="score_adaptive", x=rows[0], k=16, target_se="x"),
+           dict(op="score_adaptive", x=rows[0], k=16, target_se=-1.0),
+           dict(op="score_adaptive", x=rows[0], k=16, ess_floor=1e9),
+           dict(op="score_adaptive", x=rows[0], k=16),
+           dict(op="score", x=rows[0], k=16, target_se=0.5)]
+    for req in bad:
+        try:
+            cli.request(req.pop("op"), req.pop("x"), **req)
+        except TierError as e:
+            assert e.code == "bad_request", (e.code, str(e), req)
+        else:
+            raise AssertionError(f"malformed request was served: {req}")
+    assert np.isfinite(cli.score(rows[0], k=16)[0]), \
+        "connection did not survive the bad_request volley"
+
+    # -- 4. bulk lane yields to interactive traffic -------------------------
+    def p50(n=20):
+        lat = []
+        for _ in range(n):
+            t0 = time.monotonic()
+            cli.score(rows[0], k=16)
+            lat.append(time.monotonic() - t0)
+        return statistics.median(lat)
+
+    idle_p50 = p50()
+    n_job = 48
+    jx = (np.random.RandomState(1).rand(n_job, D) > 0.5).astype(np.float32)
+    doc = cli.submit_job([r.tolist() for r in jx], job_op="score_adaptive",
+                         k=K_MAX, target_se=1e-6, seed=JOB_SEED)
+    job_id = doc["job"]
+    burst_p50 = p50()
+    mid = cli.job_status(job_id)
+    bound = max(1.0, 8.0 * idle_p50)
+    assert burst_p50 <= bound, \
+        f"interactive p50 under bulk {burst_p50:.3f}s exceeds the stated " \
+        f"bound {bound:.3f}s (idle p50 {idle_p50:.3f}s)"
+    deadline = time.monotonic() + 300
+    while True:
+        st = cli.job_status(job_id, results=True)
+        if st["state"] in ("done", "failed"):
+            break
+        assert time.monotonic() < deadline, f"job stalled: {st}"
+        time.sleep(0.02)
+    assert st["state"] == "done", st
+    seeds = np.array([(JOB_SEED + i) % 2 ** 31 for i in range(n_job)],
+                     np.int32)
+    ref = np.asarray(sharded_score_adaptive_offline(
+        params, eng.cfg, mesh, eng._base_key, seeds, jx, k_cap=K_MAX,
+        target_se=1e-6, k_chunk=K_CHUNK))
+    got = np.asarray(st["results"], np.float32)
+    assert np.array_equal(got, ref), \
+        "bulk job results != offline twin (background pacing touched bits)"
+    assert "work_estimates" in cli.stats(), "stats lost work_estimates"
+
+    # -- 5. checkpoint mid-run, resume bitwise on a FRESH tier --------------
+    with tempfile.TemporaryDirectory(prefix="iwae-job-ckpt-") as ckpt:
+        n_ck = 24
+        cx = (np.random.RandomState(2).rand(n_ck, D) > 0.5).astype(
+            np.float32)
+        crows = [r.tolist() for r in cx]
+        doc = cli.submit_job(crows, job_op="score_adaptive", k=K_MAX,
+                             target_se=1e-6, seed=JOB_SEED,
+                             checkpoint_dir=ckpt, checkpoint_every=4)
+        cid = doc["job"]
+        deadline = time.monotonic() + 300
+        while True:
+            st = cli.job_status(cid)
+            if st["checkpointed"] >= 4:
+                break
+            assert st["state"] in ("running", "done"), st
+            assert time.monotonic() < deadline, f"no checkpoint: {st}"
+            time.sleep(0.002)
+        interrupted_at = st["checkpointed"]
+        cli.close()
+        tier.stop()         # mid-run interruption: the pump dies with it
+
+        tier2, eng2, mesh2 = _build_tier(model, jax, np, make_mesh,
+                                         ShardedScoreEngine, ServingTier,
+                                         cfg, params, bulk_headroom=2)
+        cli = TierClient("127.0.0.1", tier2.port, timeout_s=120.0)
+        doc = cli.submit_job(crows, job_op="score_adaptive", k=K_MAX,
+                             target_se=1e-6, seed=JOB_SEED,
+                             checkpoint_dir=ckpt, checkpoint_every=4,
+                             resume=True)
+        assert doc["completed"] >= interrupted_at, \
+            f"resume lost the checkpointed prefix: {doc}"
+        rid = doc["job"]
+        deadline = time.monotonic() + 300
+        while True:
+            st = cli.job_status(rid, results=True)
+            if st["state"] in ("done", "failed"):
+                break
+            assert time.monotonic() < deadline, f"resumed job stalled: {st}"
+            time.sleep(0.02)
+        assert st["state"] == "done", st
+        seeds = np.array([(JOB_SEED + i) % 2 ** 31 for i in range(n_ck)],
+                         np.int32)
+        ref = np.asarray(sharded_score_adaptive_offline(
+            params, eng2.cfg, mesh2, eng2._base_key, seeds, cx,
+            k_cap=K_MAX, target_se=1e-6, k_chunk=K_CHUNK))
+        got = np.asarray(st["results"], np.float32)
+        assert np.array_equal(got, ref), \
+            "resumed job != uninterrupted reference (resume broke bits)"
+        cli.close()
+        tier2.stop()
+
+    print(f"adaptive-k smoke OK: ragged (batch, target) stream 0 recompiles,"
+          f" early-stop == fixed-k prefix bitwise, typed bad_request x"
+          f"{len(bad)}, bulk p50 {burst_p50 * 1e3:.1f}ms "
+          f"(idle {idle_p50 * 1e3:.1f}ms, bound {bound:.2f}s, "
+          f"{mid['completed']}/{n_job} rows done mid-burst), "
+          f"checkpoint at {interrupted_at} rows resumed bitwise on mesh "
+          f"{dict(mesh.shape)}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except AssertionError as e:
+        print(f"adaptive-k smoke FAILED: {e}", file=sys.stderr)
+        sys.exit(1)

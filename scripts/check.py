@@ -79,13 +79,21 @@ machine-readable summary.
    and zero drift, a 2x-slowdown fake clock trips a typed ``prof/drift``
    finding naming the program, and ``/metrics`` + ``/prof`` +
    ``/healthz`` serve it over HTTP;
-18. **perf gate** (``iwae-prof --diff``, analysis/regress.py) — the
+18. **adaptive-k smoke** (scripts/adaptive_k_smoke.py) — accuracy-
+   targeted scoring + the bulk offline lane over a real socket tier: a
+   ragged (batch, target) stream with zero recompiles, early-stopped
+   rows bitwise equal to the fixed-k prefix, typed ``bad_request`` for
+   malformed targets on a surviving connection, a background job
+   yielding to an interactive burst within the stated p50 bound, and a
+   checkpointed job interrupted mid-run resuming bitwise on a fresh
+   tier;
+19. **perf gate** (``iwae-prof --diff``, analysis/regress.py) — the
    statistical perf-regression gate: every committed
    ``results/*_bench.json`` diffed against the committed
    ``results/perf_baseline.json`` (paired medians + rank test + noise
    floor from recorded spreads); a regressed artifact without a baseline
    refresh fails the gate;
-19. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
+20. **tier-1 pytest** (the fast profile, ``-m 'not slow'``) with
    ``--sanitize`` armed.
 
 Every full-gate run writes ``results/check_summary.json`` (per-stage status,
@@ -295,6 +303,12 @@ def run_prof_smoke() -> dict:
                                                   "prof_smoke.py")])
 
 
+def run_adaptive_k_smoke() -> dict:
+    return run_step("adaptive-k smoke",
+                    [sys.executable, os.path.join("scripts",
+                                                  "adaptive_k_smoke.py")])
+
+
 def run_perf_gate() -> dict:
     """The statistical perf-regression gate (analysis/regress.py): diff
     every committed ``results/*_bench.json`` against the committed
@@ -361,6 +375,7 @@ def main(argv=None) -> int:
         stages.append(run_trace_smoke())
         stages.append(run_race_smoke())
         stages.append(run_prof_smoke())
+        stages.append(run_adaptive_k_smoke())
         stages.append(run_perf_gate())
     if not args.lint_only:
         stages.append(run_tests(passthrough))

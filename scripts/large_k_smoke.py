@@ -58,7 +58,8 @@ def main() -> int:
                              k_chunk=250, k_max=5000, k=50, max_batch=4,
                              timeout_s=120.0)
     warm = eng.warmup()
-    assert warm["programs"] == len(eng.ladder.buckets), warm
+    # score + score_adaptive pre-built per rung
+    assert warm["programs"] == 2 * len(eng.ladder.buckets), warm
 
     rng = np.random.RandomState(0)
     x = (rng.rand(6, D) > 0.5).astype(np.float32)

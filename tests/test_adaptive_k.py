@@ -1,0 +1,663 @@
+"""Adaptive-k scoring tests (ISSUE 20).
+
+Layers, bottom up:
+
+* the AUGMENTED online carry (``ops.logsumexp.OnlineLSEVar``) — the
+  ``(m, s)`` half must stay bitwise identical to the plain ``OnlineLSE``
+  recurrence (the early-stopped-prefix contract rides on it), and the
+  ``s2``/ESS/SE statistics folded across ragged chunk boundaries must
+  equal the exact flat-batch numbers;
+* ``_merge_lse_var_over_sp`` — the cross-device merge of the augmented
+  carry, unit-tested under shard_map on the fake-device mesh with the
+  same suite shape as the plain ``_merge_lse_over_sp`` tests
+  (sequential-merge equality, idle-device identity, all-``-inf`` never
+  NaN, ragged chunk states vs flat statistics);
+* ``weight_diagnostics(n_samples=)`` — ``diag/ess_frac`` under dynamic k
+  normalizes by the ACTUAL count, never the padded leading axis;
+* the adaptive engine — bitwise parity with the offline twin, the
+  early-stop == fixed-k-prefix pin, replica independence under the
+  original seed (the reroute contract), zero recompiles over a ragged
+  (batch, target) stream;
+* the typed ``bad_request`` for malformed accuracy targets, pinned at
+  all three admission depths: engine submit, replica router, and the
+  TCP wire — one shared validator, one meaning everywhere;
+* the router's estimated-work dispatch: measured ``k_used`` feeds the
+  per-(op, target-class) EWMA, and selection balances summed estimated
+  work instead of request counts for adaptive traffic.
+"""
+
+import threading
+from concurrent.futures import Future
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from iwae_replication_project_tpu.models import iwae as model
+from iwae_replication_project_tpu.ops.logsumexp import (
+    OnlineLSE,
+    OnlineLSEVar,
+    lse_var_stats,
+    online_logsumexp_init,
+    online_logsumexp_update,
+    online_lse_var_init,
+    online_lse_var_merge,
+    online_lse_var_update,
+)
+from iwae_replication_project_tpu.parallel import make_mesh
+from iwae_replication_project_tpu.parallel.eval import (
+    _merge_lse_over_sp,
+    _merge_lse_var_over_sp,
+    sharded_score_adaptive_offline,
+)
+from iwae_replication_project_tpu.parallel.mesh import AXES, shard_map
+from iwae_replication_project_tpu.serving import ShardedScoreEngine
+from iwae_replication_project_tpu.serving.buckets import (
+    target_class,
+    validate_adaptive_target,
+)
+from iwae_replication_project_tpu.telemetry.diagnostics import (
+    weight_diagnostics,
+)
+
+D = 12
+CFG = model.ModelConfig(n_hidden_enc=(16, 8), n_latent_enc=(6, 3),
+                        n_hidden_dec=(8, 16), n_latent_dec=(6, 12), x_dim=D)
+CHUNK = 4
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    params = model.init_params(jax.random.PRNGKey(0), CFG)
+    x = (np.random.RandomState(0).rand(9, D) > 0.5).astype(np.float32)
+    return {"params": params, "x": x,
+            "base_key": jax.device_put(jax.random.PRNGKey(7))}
+
+
+def make_sharded(tiny, mesh, **kw):
+    kw.setdefault("k_chunk", CHUNK)
+    kw.setdefault("k_max", 100)
+    kw.setdefault("k", 8)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("timeout_s", 60.0)
+    return ShardedScoreEngine(params=tiny["params"], model_config=CFG,
+                              mesh=mesh, **kw)
+
+
+def _flat_stats(log_w):
+    """Exact flat-batch reference for (ess, se) of ``[n, B]`` log-weights,
+    in float64: the numbers the streamed second-moment carry must match."""
+    log_w = np.asarray(log_w, np.float64)
+    n = log_w.shape[0]
+    m = log_w.max(axis=0)
+    w = np.exp(log_w - m)
+    s, s2 = w.sum(0), (w * w).sum(0)
+    ess = s * s / s2
+    var = np.maximum(s2 / (s * s) - 1.0 / n, 0.0) * n / max(n - 1, 1)
+    return ess, np.sqrt(var)
+
+
+# ---------------------------------------------------------------------------
+# the augmented carry: chunked streaming == exact flat-batch statistics
+# ---------------------------------------------------------------------------
+
+def test_lse_var_update_keeps_m_s_bitwise_equal_to_plain_carry():
+    """THE prefix contract's foundation: the (m, s) half of every
+    OnlineLSEVar update is expression-identical to OnlineLSE — fold the
+    same chunks through both and every intermediate state must match
+    BITWISE, so a consumer reading log p̂ off the augmented carry gets the
+    plain carry's bits."""
+    rng = np.random.RandomState(11)
+    plain = online_logsumexp_init((5,))
+    aug = online_lse_var_init((5,))
+    for n in (3, 1, 4, 2):
+        chunk = jnp.asarray(rng.randn(n, 5).astype(np.float32) * 3)
+        plain = online_logsumexp_update(plain, chunk, axis=0)
+        aug = online_lse_var_update(aug, chunk, axis=0)
+        np.testing.assert_array_equal(np.asarray(plain.m), np.asarray(aug.m))
+        np.testing.assert_array_equal(np.asarray(plain.s), np.asarray(aug.s))
+        assert int(plain.n) == int(aug.n)
+
+
+def test_lse_var_ragged_chunk_stream_matches_flat_statistics():
+    """Ragged chunk boundaries (3+1+4+2 samples) streamed through the
+    augmented carry yield the exact flat-batch ESS and SE."""
+    rng = np.random.RandomState(13)
+    blocks = [rng.randn(n, 6).astype(np.float32) * 2 for n in (3, 1, 4, 2)]
+    st = online_lse_var_init((6,))
+    for b in blocks:
+        st = online_lse_var_update(st, jnp.asarray(b), axis=0)
+    ess, se = lse_var_stats(st.s, st.s2, st.n)
+    want_ess, want_se = _flat_stats(np.concatenate(blocks, axis=0))
+    np.testing.assert_allclose(np.asarray(ess), want_ess, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(se), want_se, rtol=1e-5)
+    assert int(st.n) == 10
+
+
+def test_lse_var_merge_is_associative_and_matches_flat():
+    """merge(merge(a, b), c) == merge(a, merge(b, c)), and either order
+    reproduces the flat statistics — the property that lets the same carry
+    serve a scan over chunks and a psum over devices."""
+    rng = np.random.RandomState(17)
+    blocks = [rng.randn(n, 4).astype(np.float32) for n in (2, 5, 3)]
+    parts = []
+    for b in blocks:
+        parts.append(online_lse_var_update(online_lse_var_init((4,)),
+                                           jnp.asarray(b), axis=0))
+    left = online_lse_var_merge(online_lse_var_merge(parts[0], parts[1]),
+                                parts[2])
+    right = online_lse_var_merge(parts[0],
+                                 online_lse_var_merge(parts[1], parts[2]))
+    for a, b in zip(left, right):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    ess, se = lse_var_stats(left.s, left.s2, left.n)
+    want_ess, want_se = _flat_stats(np.concatenate(blocks, axis=0))
+    np.testing.assert_allclose(np.asarray(ess), want_ess, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(se), want_se, rtol=1e-5)
+
+
+def test_lse_var_stats_all_inf_row_never_nan():
+    """An all--inf row (no live sample) must read ess=0, se=+inf —
+    defined, never NaN, never falsely converged — both straight from the
+    init state and after folding an all--inf chunk."""
+    st = online_lse_var_init((3,))
+    ess, se = lse_var_stats(st.s, st.s2, st.n)
+    assert np.array_equal(np.asarray(ess), np.zeros(3, np.float32))
+    assert np.all(np.isposinf(np.asarray(se)))
+    st = online_lse_var_update(
+        st, jnp.full((4, 3), -jnp.inf, jnp.float32), axis=0)
+    ess, se = lse_var_stats(st.s, st.s2, st.n)
+    assert not np.any(np.isnan(np.asarray(ess)))
+    assert np.array_equal(np.asarray(ess), np.zeros(3, np.float32))
+    assert np.all(np.isposinf(np.asarray(se)))
+
+
+# ---------------------------------------------------------------------------
+# _merge_lse_var_over_sp: the cross-device augmented merge, in isolation
+# ---------------------------------------------------------------------------
+
+def _run_merge_var(mesh, m, s, s2):
+    """Feed per-device augmented partial states ``[sp, B]`` through the
+    real merge under shard_map; returns host (m_g, safe, s_g, s2_g)."""
+    def local(m_l, s_l, s2_l):
+        state = OnlineLSEVar(m=m_l[0], s=s_l[0], s2=s2_l[0],
+                             n=jnp.int32(0))
+        return _merge_lse_var_over_sp(state)
+
+    fn = jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(P(AXES.sp), P(AXES.sp), P(AXES.sp)),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False))
+    return tuple(np.asarray(v)
+                 for v in fn(jnp.asarray(m), jnp.asarray(s),
+                             jnp.asarray(s2)))
+
+
+def _run_merge_plain(mesh, m, s):
+    """The plain merge under the same harness (the bitwise (m, s) twin)."""
+    def local(m_l, s_l):
+        state = OnlineLSE(m=m_l[0], s=s_l[0], n=jnp.int32(0))
+        return _merge_lse_over_sp(state)
+
+    fn = jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(P(AXES.sp), P(AXES.sp)),
+        out_specs=(P(), P(), P()),
+        check_vma=False))
+    return tuple(np.asarray(v) for v in fn(jnp.asarray(m), jnp.asarray(s)))
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_var_merge_matches_sequential_associative_merge(devices, sp):
+    mesh = make_mesh(dp=1, sp=sp)
+    rng = np.random.RandomState(3)
+    m = rng.randn(sp, 5).astype(np.float32) * 10
+    s = rng.rand(sp, 5).astype(np.float32) + 0.1
+    s2 = rng.rand(sp, 5).astype(np.float32) + 0.05
+    m_g, safe, s_g, s2_g = _run_merge_var(mesh, m, s, s2)
+    want = OnlineLSEVar(m=jnp.asarray(m[0]), s=jnp.asarray(s[0]),
+                        s2=jnp.asarray(s2[0]), n=jnp.int32(0))
+    for i in range(1, sp):
+        want = online_lse_var_merge(
+            want, OnlineLSEVar(m=jnp.asarray(m[i]), s=jnp.asarray(s[i]),
+                               s2=jnp.asarray(s2[i]), n=jnp.int32(0)))
+    np.testing.assert_array_equal(m_g, np.asarray(want.m))
+    np.testing.assert_allclose(s_g, np.asarray(want.s), rtol=1e-6)
+    np.testing.assert_allclose(s2_g, np.asarray(want.s2), rtol=1e-6)
+    # the (m, s) half must be BITWISE what the plain sp merge computes —
+    # log p̂ finalized off the augmented carry is the fixed-k program's bits
+    pm, psafe, ps = _run_merge_plain(mesh, m, s)
+    np.testing.assert_array_equal(m_g, pm)
+    np.testing.assert_array_equal(safe, psafe)
+    np.testing.assert_array_equal(s_g, ps)
+
+
+def test_var_merge_idle_device_contributes_exact_zero(devices):
+    """A device whose blocks were all masked carries (m=-inf, s=0, s2=0)
+    — the merge must treat that as an EXACT zero contribution to BOTH
+    moments, not a NaN and not a drift."""
+    mesh = make_mesh(dp=1, sp=2)
+    m = np.stack([np.array([1.0, -2.0], np.float32),
+                  np.full((2,), -np.inf, np.float32)])
+    s = np.stack([np.array([0.5, 1.5], np.float32),
+                  np.zeros((2,), np.float32)])
+    s2 = np.stack([np.array([0.25, 0.75], np.float32),
+                   np.zeros((2,), np.float32)])
+    m_g, safe, s_g, s2_g = _run_merge_var(mesh, m, s, s2)
+    np.testing.assert_array_equal(m_g, m[0])
+    np.testing.assert_array_equal(safe, m[0])
+    np.testing.assert_array_equal(s_g, s[0])    # bitwise: + 0 exactly
+    np.testing.assert_array_equal(s2_g, s2[0])  # bitwise: + 0 exactly
+
+
+def test_var_merge_all_devices_all_inf_rows_never_nan(devices):
+    """No live sample anywhere: the merged sums are 0 with a finite safe
+    max, and the downstream statistics read ess=0, se=+inf — never NaN
+    (the exp(-inf - -inf) trap, squared this time)."""
+    mesh = make_mesh(dp=1, sp=2)
+    m = np.full((2, 3), -np.inf, np.float32)
+    z = np.zeros((2, 3), np.float32)
+    m_g, safe, s_g, s2_g = _run_merge_var(mesh, m, z, z)
+    assert np.all(np.isneginf(m_g))
+    np.testing.assert_array_equal(safe, np.zeros(3, np.float32))
+    np.testing.assert_array_equal(s_g, np.zeros(3, np.float32))
+    np.testing.assert_array_equal(s2_g, np.zeros(3, np.float32))
+    ess, se = lse_var_stats(jnp.asarray(s_g), jnp.asarray(s2_g), 8)
+    assert not np.any(np.isnan(np.asarray(ess)))
+    assert np.array_equal(np.asarray(ess), np.zeros(3, np.float32))
+    assert np.all(np.isposinf(np.asarray(se)))
+
+
+def test_var_merge_of_ragged_chunk_states_matches_flat_stats(devices):
+    """Per-device augmented carries built from RAGGED chunk splits merge
+    over sp to the exact flat-batch ESS/SE — chunking and device placement
+    must both be invisible to the convergence statistics."""
+    mesh = make_mesh(dp=1, sp=2)
+    rng = np.random.RandomState(5)
+    blocks = [rng.randn(n, 4).astype(np.float32)
+              for n in (3, 1, 2, 5)]       # ragged chunks
+    halves = [blocks[:2], blocks[2:]]
+    m, s, s2, n_tot = [], [], [], 0
+    for chunks in halves:
+        st = online_lse_var_init((4,))
+        for c in chunks:
+            st = online_lse_var_update(st, jnp.asarray(c), axis=0)
+        m.append(np.asarray(st.m))
+        s.append(np.asarray(st.s))
+        s2.append(np.asarray(st.s2))
+        n_tot += int(st.n)
+    m_g, safe, s_g, s2_g = _run_merge_var(
+        mesh, np.stack(m), np.stack(s), np.stack(s2))
+    ess, se = lse_var_stats(jnp.asarray(s_g), jnp.asarray(s2_g), n_tot)
+    want_ess, want_se = _flat_stats(np.concatenate(blocks, axis=0))
+    np.testing.assert_allclose(np.asarray(ess), want_ess, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(se), want_se, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# weight_diagnostics under dynamic k (the diag/ess_frac fix)
+# ---------------------------------------------------------------------------
+
+def test_weight_diagnostics_dynamic_n_matches_unpadded():
+    """A [16, B] buffer holding 8 live samples + 8 rows of -inf padding
+    with n_samples=8 must report the SAME ess / ess_frac / log_weight_var
+    as the unpadded [8, B] call — the padded leading axis must never be
+    the denominator."""
+    rng = np.random.RandomState(23)
+    live = rng.randn(8, 5).astype(np.float32)
+    padded = np.concatenate(
+        [live, np.full((8, 5), -np.inf, np.float32)], axis=0)
+    want = weight_diagnostics(jnp.asarray(live))
+    got = weight_diagnostics(jnp.asarray(padded), n_samples=8)
+    for key in ("diag/ess", "diag/ess_frac", "diag/log_weight_var"):
+        np.testing.assert_allclose(float(got[key]), float(want[key]),
+                                   rtol=1e-5, err_msg=key)
+    # without n_samples the fraction would have silently halved
+    assert abs(float(got["diag/ess_frac"])
+               - float(want["diag/ess"]) / 8.0) < 1e-6
+
+
+def test_weight_diagnostics_per_row_counts():
+    """Per-row n_samples ([B]): each column normalizes by ITS OWN count —
+    the adaptive scorer's rows stop at different k_used."""
+    rng = np.random.RandomState(29)
+    full = rng.randn(8, 2).astype(np.float32)
+    counts = np.array([8, 4], np.float32)
+    padded = full.copy()
+    padded[4:, 1] = -np.inf
+    got = weight_diagnostics(jnp.asarray(padded), n_samples=counts)
+    e0 = weight_diagnostics(jnp.asarray(full[:, :1]))
+    e1 = weight_diagnostics(jnp.asarray(full[:4, 1:]))
+    np.testing.assert_allclose(
+        float(got["diag/ess"]),
+        (float(e0["diag/ess"]) + float(e1["diag/ess"])) / 2, rtol=1e-5)
+    np.testing.assert_allclose(
+        float(got["diag/ess_frac"]),
+        (float(e0["diag/ess_frac"]) + float(e1["diag/ess_frac"])) / 2,
+        rtol=1e-5)
+
+
+def test_weight_diagnostics_zero_count_never_nan():
+    """n_samples=0 (a row that drew nothing yet): every scalar is 0, never
+    NaN — a NaN here would read as a health number (and abort under the
+    debug_nans sanitize profile)."""
+    log_w = jnp.full((4, 3), -jnp.inf, jnp.float32)
+    got = weight_diagnostics(log_w, n_samples=0)
+    for key, v in got.items():
+        v = float(v)
+        assert not np.isnan(v), key
+        assert v == 0.0, key
+
+
+# ---------------------------------------------------------------------------
+# the adaptive engine: offline parity, prefix contract, replica independence
+# ---------------------------------------------------------------------------
+
+def test_adaptive_engine_bitwise_matches_offline_twin(devices, tiny):
+    """Engine-served score_adaptive rows == the offline
+    parallel/eval.sharded_score_adaptive_offline twin at explicit seeds,
+    BITWISE — through coalescing, bucket padding, and slicing."""
+    mesh = make_mesh(dp=2, sp=2)
+    eng = make_sharded(tiny, mesh)
+    n = 5
+    seeds = np.arange(40, 40 + n, dtype=np.int32)
+    futs = [eng.submit("score_adaptive", r, k=100, seed=int(s),
+                       target_se=0.5)
+            for s, r in zip(seeds, tiny["x"][:n])]
+    eng.flush()
+    got = np.stack([np.asarray(f.result(timeout=60)) for f in futs])
+    off = np.asarray(sharded_score_adaptive_offline(
+        tiny["params"], eng.cfg, mesh, eng._base_key, seeds, tiny["x"][:n],
+        k_cap=100, target_se=0.5, k_chunk=CHUNK))
+    assert got.shape == (n, 3) and off.shape == (n, 3)
+    assert np.array_equal(got, off)
+    # the stopping rule actually engaged for at least one row: k_used
+    # sits on the sp*k_chunk grid, strictly below the cap somewhere
+    k_used = got[:, 2]
+    assert np.all(k_used % (2 * CHUNK) == 0) or np.all(k_used <= 100)
+    assert np.all(k_used >= 1) and np.all(k_used <= 100)
+
+
+def test_adaptive_early_stop_equals_fixed_k_prefix(devices, tiny):
+    """THE determinism pin: an early-stopped row's log p̂ is BITWISE the
+    plain fixed-k score at k = k_used under the same seed — stopping is a
+    pure truncation of the same sample stream, never a different one."""
+    mesh = make_mesh(dp=1, sp=2)
+    eng = make_sharded(tiny, mesh)
+    seed = 91
+    fut = eng.submit("score_adaptive", tiny["x"][0], k=100, seed=seed,
+                     target_se=0.5)
+    eng.flush()
+    log_px, se, k_used = (float(v) for v in np.asarray(fut.result(60)))
+    assert 1 <= k_used <= 100 and np.isfinite(se)
+    fixed = eng.submit("score", tiny["x"][0], k=int(k_used), seed=seed)
+    eng.flush()
+    assert np.float32(log_px) == np.asarray(fixed.result(60)), \
+        (log_px, k_used)
+
+
+def test_adaptive_result_independent_of_replica(devices, tiny):
+    """The reroute contract: the SAME (row, seed, cap, target) served by
+    two independently constructed replicas returns the bitwise-identical
+    triple — results are a pure function of (weights, payload, seed,
+    target, cap), never of which engine answered (so a rerouted retry
+    with the original seed is invisible)."""
+    mesh = make_mesh(dp=1, sp=2)
+    out = []
+    for _ in range(2):
+        eng = make_sharded(tiny, mesh)
+        fut = eng.submit("score_adaptive", tiny["x"][1], k=64, seed=17,
+                         target_se=0.4, ess_floor=3.0)
+        eng.flush()
+        out.append(np.asarray(fut.result(60)))
+    assert np.array_equal(out[0], out[1])
+
+
+def test_adaptive_zero_recompiles_over_ragged_target_stream(devices, tiny):
+    """THE tentpole pin: (k_cap, target_se, ess_floor) are dynamic
+    scalars, so ONE warm executable per bucket serves every (batch, cap,
+    target) combination — zero AOT misses, zero XLA recompiles."""
+    from iwae_replication_project_tpu.utils.compile_cache import (
+        cache_stats, stats_delta)
+
+    mesh = make_mesh(dp=2, sp=2)
+    eng = make_sharded(tiny, mesh)
+    eng.warmup()
+    s0 = cache_stats()
+    futs = []
+    stream = ((1, 100, 0.5, None), (3, 7, 0.2, None), (2, 64, None, 4.0),
+              (8, 100, 1.0, 2.0), (5, 99, 0.05, None), (1, 8, None, 2.0))
+    for n, cap, tse, ef in stream:
+        futs.extend(eng.submit("score_adaptive", r, k=cap, target_se=tse,
+                               ess_floor=ef) for r in tiny["x"][:n])
+    eng.flush()
+    for f in futs:
+        out = np.asarray(f.result(timeout=60))
+        assert out.shape == (3,) and np.isfinite(out).all()
+    d = stats_delta(s0)
+    assert d["aot_misses"] == 0, f"ragged (batch, target) stream compiled: {d}"
+    c = eng.metrics.snapshot()["counters"]
+    assert c["recompiles"] == 0
+
+
+def test_adaptive_profiler_attributes_k_used(devices, tiny):
+    """The SLO/profiling layer can't be gamed by easy rows: the dispatch
+    profiler's per-key snapshot carries the measured k_used EWMA, not the
+    cap."""
+    mesh = make_mesh(dp=1, sp=2)
+    eng = make_sharded(tiny, mesh)
+    fut = eng.submit("score_adaptive", tiny["x"][0], k=100, seed=3,
+                     target_se=0.5)
+    eng.flush()
+    np.asarray(fut.result(60))
+    snap = eng.profiler.snapshot()
+    hits = {key: st for key, st in snap["keys"].items()
+            if "score_adaptive" in key}
+    assert hits, sorted(snap["keys"])
+    st = next(iter(hits.values()))
+    assert st["ewma_k_used"] is not None
+    assert 1 <= st["ewma_k_used"] <= 100
+
+
+# ---------------------------------------------------------------------------
+# the typed bad_request at all three admission depths (ONE shared validator)
+# ---------------------------------------------------------------------------
+
+BAD_TARGETS = (
+    {"target_se": "x"},                      # non-number
+    {"target_se": -1.0},                     # non-positive
+    {"target_se": float("nan")},             # non-finite
+    {"ess_floor": True},                     # bool masquerading as number
+    {"ess_floor": 1e9},                      # can never be met under the cap
+    {},                                      # target-less adaptive request
+)
+
+
+def test_validate_adaptive_target_rules():
+    for bad in BAD_TARGETS:
+        with pytest.raises(ValueError):
+            validate_adaptive_target(bad.get("target_se"),
+                                     bad.get("ess_floor"), 100, 100)
+    # normalization: None -> 0.0 (disabled), k_cap validated as a k
+    assert validate_adaptive_target(0.1, None, 50, 100) == (0.1, 0.0, 50)
+    assert validate_adaptive_target(None, 8.0, 50, 100) == (0.0, 8.0, 50)
+    with pytest.raises(ValueError, match="out of range"):
+        validate_adaptive_target(0.1, None, 101, 100)
+
+
+def test_target_class_decade_labels():
+    assert target_class(1e-2, 0.0) == "se:e-2"
+    assert target_class(0.05, 0.0) == "se:e-2"
+    assert target_class(0.0, 250.0) == "ess:e+2"
+    # an accounting key only: distinct exact targets share a decade class
+    assert target_class(0.011, 0.0) == target_class(0.099, 0.0)
+
+
+def test_engine_depth_rejects_malformed_targets(devices, tiny):
+    """Depth 1 — engine submit: every malformed target is a synchronous
+    ValueError before any queueing or program build; targets on a fixed-k
+    op are rejected too."""
+    eng = make_sharded(tiny, make_mesh(dp=1, sp=1))
+    for bad in BAD_TARGETS:
+        with pytest.raises(ValueError):
+            eng.submit("score_adaptive", tiny["x"][0], k=50, **bad)
+    with pytest.raises(ValueError, match="fixed-k"):
+        eng.submit("score", tiny["x"][0], k=5, target_se=0.1)
+    assert eng.metrics.snapshot()["counters"]["submitted"] == 0
+
+
+class FakeAdaptiveEngine:
+    """The engine surface plus the adaptive capability bits: serves
+    score_adaptive, returns the [log_px, se, k_used] triple with a
+    scripted k_used so router EWMA behavior is checkable."""
+
+    def __init__(self, mode="auto", k_used=50.0, dims=4):
+        self.mode = mode
+        self.k_used = k_used
+        self.row_dims = {"score": dims, "score_adaptive": dims}
+        self._ADAPTIVE_OPS = ("score_adaptive",)
+        self.k = 5
+        self.k_max = 1000
+        self.lock = threading.Lock()
+        self.held = []
+        self.served = []
+
+    def submit(self, op, row, k=None, *, seed=None, target_se=None,
+               ess_floor=None):
+        with self.lock:
+            self.served.append((op, k, seed, target_se, ess_floor))
+            f = Future()
+            if self.mode == "manual":
+                self.held.append(f)
+            else:
+                f.set_result(np.array(
+                    [float(seed or 0), 0.01, self.k_used], np.float32))
+            return f
+
+    def finish(self):
+        with self.lock:
+            held, self.held = self.held, []
+        for f in held:
+            f.set_result(np.array([0.0, 0.01, self.k_used], np.float32))
+
+    def start(self):
+        pass
+
+    def stop(self, timeout_s=None):
+        self.finish()
+
+    def warmup(self, ops=(), ks=None):
+        return {}
+
+
+def test_router_depth_rejects_malformed_targets():
+    """Depth 2 — the replica router: the same shared validator speaks
+    synchronously at tier admission; nothing leaks past rejection."""
+    from iwae_replication_project_tpu.serving.frontend import ReplicaRouter
+
+    eng = FakeAdaptiveEngine()
+    r = ReplicaRouter([eng])
+    for bad in BAD_TARGETS:
+        with pytest.raises(ValueError):
+            r.submit("score_adaptive", [0.0] * 4, k=50, **bad)
+    with pytest.raises(ValueError, match="fixed-k"):
+        r.submit("score", [0.0] * 4, k=5, target_se=0.1)
+    assert r.outstanding == 0 and eng.served == []
+    # the cap defaults to the fleet k_max at ADMISSION
+    r.submit("score_adaptive", [0.0] * 4, target_se=0.1).result(timeout=5)
+    assert eng.served[-1][1] == 1000
+    r.drain(timeout_s=5)
+
+
+def test_wire_depth_rejects_malformed_targets():
+    """Depth 3 — the TCP wire: every malformed target is a typed
+    ``bad_request`` RESPONSE on a live connection, and the connection
+    survives all of them."""
+    from iwae_replication_project_tpu.serving.frontend import (
+        ServingTier, TierClient)
+    from iwae_replication_project_tpu.serving.frontend.client import (
+        TierError)
+
+    eng = FakeAdaptiveEngine()
+    tier = ServingTier([eng], monitor_interval_s=60.0).start()
+    try:
+        cli = TierClient("127.0.0.1", tier.port)
+        assert "score_adaptive" in cli.info()["adaptive_ops"]
+        for bad in BAD_TARGETS:
+            with pytest.raises(TierError) as ei:
+                cli.request("score_adaptive", [0.0] * 4, k=50, **bad)
+            assert ei.value.code == "bad_request", bad
+        with pytest.raises(TierError) as ei:
+            cli.request("score", [0.0] * 4, k=5, target_se=0.1)
+        assert ei.value.code == "bad_request"
+        # the connection survived all seven rejections and still serves
+        out = cli.score_adaptive([0.0] * 4, k=50, target_se=0.1)
+        assert len(out) == 1 and len(out[0]) == 3
+        cli.close()
+    finally:
+        tier.stop()
+
+
+# ---------------------------------------------------------------------------
+# router estimated-work dispatch (fake engines — no device)
+# ---------------------------------------------------------------------------
+
+def test_router_k_used_feeds_work_ewma():
+    """A completed adaptive request's measured k_used column becomes its
+    (op, target-class) work estimate; the next result folds in at the
+    EWMA weight."""
+    from iwae_replication_project_tpu.serving.frontend import ReplicaRouter
+    from iwae_replication_project_tpu.serving.frontend.router import (
+        WORK_EWMA_ALPHA)
+
+    eng = FakeAdaptiveEngine(k_used=100.0)
+    r = ReplicaRouter([eng])
+    r.submit("score_adaptive", [0.0] * 4, k=1000,
+             target_se=1e-2).result(timeout=5)
+    assert r.work_estimates() == {"score_adaptive/se:e-2": 100.0}
+    eng.k_used = 200.0
+    r.submit("score_adaptive", [0.0] * 4, k=1000,
+             target_se=1e-2).result(timeout=5)
+    want = 100.0 + WORK_EWMA_ALPHA * (200.0 - 100.0)
+    assert r.work_estimates()["score_adaptive/se:e-2"] == pytest.approx(want)
+    # fixed-k traffic never touches the estimator
+    r.submit("score", [0.0] * 4, k=5).result(timeout=5)
+    assert set(r.work_estimates()) == {"score_adaptive/se:e-2"}
+    r.drain(timeout_s=5)
+
+
+def test_router_balances_adaptive_by_estimated_work_not_inflight():
+    """Ten easy rows must not count like ten expensive ones: a replica
+    holding MORE requests of a cheap (EWMA-primed) class must still win
+    over a peer holding one expensive unprimed request — the opposite of
+    what least-inflight would pick."""
+    from iwae_replication_project_tpu.serving.frontend import ReplicaRouter
+
+    e0, e1 = FakeAdaptiveEngine(k_used=10.0), FakeAdaptiveEngine()
+    r = ReplicaRouter([e0, e1], affinity_slack=0)
+    # prime the (score_adaptive, se:e-2) EWMA at 10 via one completed
+    # request (auto mode answers immediately; e0 wins the idle tie-break)
+    r.submit("score_adaptive", [0.0] * 4, k=1000,
+             target_se=1e-2).result(timeout=5)
+    assert r.work_estimates()["score_adaptive/se:e-2"] == 10.0
+    e0.mode = e1.mode = "manual"
+    # an unprimed class (se:e-4) costs its cap: 1000 estimated samples,
+    # placed on e0 (idle tie-break to the lowest index)
+    r.submit("score_adaptive", [0.0] * 4, k=1000, target_se=1e-4)
+    assert len(e0.served) == 2
+    # two primed-class requests (10 each) pile onto e1: 0 < 1000, then
+    # affinity holds at 10 <= 10
+    r.submit("score_adaptive", [0.0] * 4, k=1000, target_se=1e-2)
+    r.submit("score_adaptive", [0.0] * 4, k=1000, target_se=1e-2)
+    assert len(e1.served) == 2
+    # the decisive pick: e0 has 1 outstanding (work 1000), e1 has 2
+    # (work 20). Least-inflight would choose e0; estimated work must
+    # choose e1.
+    r.submit("score_adaptive", [0.0] * 4, k=1000, target_se=1e-3)
+    assert len(e1.served) == 3 and len(e0.served) == 2
+    e0.finish()
+    e1.finish()
+    r.drain(timeout_s=5)

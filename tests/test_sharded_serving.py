@@ -326,7 +326,8 @@ def test_sharded_engine_zero_recompiles_over_ragged_batch_and_k(devices,
     mesh = make_mesh(dp=2, sp=2)
     eng = make_sharded(tiny, mesh)
     warm = eng.warmup()
-    assert warm["programs"] == len(eng.ladder.buckets)
+    # score + score_adaptive pre-built per rung (targets dynamic too)
+    assert warm["programs"] == 2 * len(eng.ladder.buckets)
     s0 = cache_stats()
     futs = []
     for n, k in ((1, 50), (3, 7), (2, 1), (8, 100), (5, 99), (1, 8),
